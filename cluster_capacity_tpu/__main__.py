@@ -1,0 +1,4 @@
+"""`python -m cluster_capacity_tpu` → hypercc multiplexer."""
+from .cli.hypercc import main
+
+main()

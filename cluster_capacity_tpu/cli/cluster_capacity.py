@@ -1,0 +1,129 @@
+"""cluster-capacity CLI front-end.
+
+Flag surface mirrors /root/reference/cmd/cluster-capacity/app/options/options.go:65-77
+(--kubeconfig --podspec --max-limit --exclude-nodes --default-config --verbose
+-o/--output) plus app/server.go:83-100 validation.  Additions for the
+TPU-native offline path:
+
+- `--snapshot FILE` — cluster state from a YAML/JSON file (a dict of object
+  lists, or a v1.List of objects) instead of a live apiserver.  This replaces
+  the fake-API-server copy (SyncWithClient, simulator.go:176-295) for offline
+  what-if analysis.
+- `--parity` — bit-exact kube-scheduler arithmetic (float64) instead of the
+  TPU fast path.
+
+A live --kubeconfig path is honored when the `kubernetes` python client is
+installed; the CC_INCLUSTER env var mirrors server.go:88.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import urllib.request
+from typing import List, Optional
+
+from ..framework import ClusterCapacity
+from ..models.podspec import (default_pod, parse_pod_text, validate_pod)
+from ..utils.config import SchedulerProfile, load_scheduler_config
+from ..utils.report import print_review
+from ..utils.snapshot_io import load_snapshot_objects
+
+
+def build_parser(prog: str = "cluster-capacity") -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog=prog,
+        description=("Cluster-capacity analysis: estimate how many instances "
+                     "of a given pod the cluster can schedule."))
+    p.add_argument("--kubeconfig", default="",
+                   help="Path to the kubeconfig file to use for the analysis.")
+    p.add_argument("--snapshot", default="",
+                   help="Path to a cluster-snapshot YAML/JSON file "
+                        "(offline alternative to --kubeconfig).")
+    p.add_argument("--podspec", required=False, default="",
+                   help="Path to JSON or YAML file containing pod definition. "
+                        "http(s):// URLs are accepted.")
+    p.add_argument("--max-limit", dest="max_limit", type=int, default=0,
+                   help="Number of instances of pod to be scheduled after "
+                        "which analysis stops. By default unlimited.")
+    p.add_argument("--exclude-nodes", dest="exclude_nodes", default="",
+                   help="Comma-separated list of node names to exclude.")
+    p.add_argument("--default-config", dest="default_config", default="",
+                   help="Path to KubeSchedulerConfiguration file.")
+    p.add_argument("--verbose", action="store_true",
+                   help="Verbose mode")
+    p.add_argument("-o", "--output", default="",
+                   help="Output format. One of: json|yaml.")
+    p.add_argument("--parity", action="store_true",
+                   help="Bit-exact kube-scheduler score arithmetic (float64).")
+    return p
+
+
+def _read_podspec(path: str) -> str:
+    if path.startswith("http://") or path.startswith("https://"):
+        with urllib.request.urlopen(path) as r:  # nosec - mirrors reference
+            return r.read().decode()
+    with open(path) as f:
+        return f.read()
+
+
+def _load_live_cluster(kubeconfig: str):
+    try:
+        from kubernetes import client, config as kubeconf  # type: ignore
+    except ImportError:
+        raise SystemExit(
+            "live-cluster sync requires the `kubernetes` python client; "
+            "use --snapshot FILE for offline analysis")
+    if os.environ.get("CC_INCLUSTER") == "true":
+        kubeconf.load_incluster_config()
+    else:
+        kubeconf.load_kube_config(config_file=kubeconfig or None)
+    return client.CoreV1Api()
+
+
+def run(argv: Optional[List[str]] = None, prog: str = "cluster-capacity") -> int:
+    args = build_parser(prog).parse_args(argv)
+
+    # Validation mirrors app/server.go:83-100.
+    if not args.podspec:
+        print("Error: --podspec is required", file=sys.stderr)
+        return 1
+    if not args.snapshot and not args.kubeconfig \
+            and os.environ.get("CC_INCLUSTER") != "true":
+        print("Error: provide --snapshot, --kubeconfig, or set "
+              "CC_INCLUSTER=true", file=sys.stderr)
+        return 1
+    if args.output not in ("", "json", "yaml"):
+        print(f"Error: output format {args.output!r} not recognized",
+              file=sys.stderr)
+        return 1
+
+    pod = default_pod(parse_pod_text(_read_podspec(args.podspec)))
+    validate_pod(pod)
+
+    profile = (load_scheduler_config(args.default_config)
+               if args.default_config else SchedulerProfile())
+    if args.parity:
+        profile.compute_dtype = "float64"
+
+    exclude = [s for s in args.exclude_nodes.split(",") if s]
+    cc = ClusterCapacity(pod, max_limit=args.max_limit, profile=profile,
+                         exclude_nodes=exclude)
+    if args.snapshot:
+        objs = load_snapshot_objects(args.snapshot)
+        cc.sync_with_objects(objs.pop("nodes", []), objs.pop("pods", []), **objs)
+    else:
+        cc.sync_with_client(_load_live_cluster(args.kubeconfig))
+
+    cc.run()
+    print_review(cc.report(), verbose=args.verbose, fmt=args.output)
+    return 0
+
+
+def main() -> None:
+    sys.exit(run())
+
+
+if __name__ == "__main__":
+    main()

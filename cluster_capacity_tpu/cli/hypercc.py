@@ -1,0 +1,45 @@
+"""hypercc: busybox-style multiplexer over the CLI front-ends.
+
+Mirrors /root/reference/cmd/hypercc/main.go:30-39 — dispatch on the basename
+the binary was invoked as (or the first argument): `cluster-capacity`,
+`genpod`, or the `hypercc` umbrella.  `python -m cluster_capacity_tpu` routes
+here.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import List, Optional
+
+from . import cluster_capacity as cc_cli
+from . import genpod as genpod_cli
+
+_COMMANDS = {
+    "cluster-capacity": cc_cli.run,
+    "genpod": genpod_cli.run,
+}
+
+
+def run(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    base = os.path.basename(sys.argv[0]) if sys.argv else "hypercc"
+    if base in _COMMANDS:
+        return _COMMANDS[base](argv, prog=base)
+    if argv and argv[0] in _COMMANDS:
+        cmd = argv[0]
+        return _COMMANDS[cmd](argv[1:], prog=cmd)
+    prog = "hypercc"
+    print(f"usage: {prog} <command> [flags]\n\ncommands:\n"
+          "  cluster-capacity   estimate schedulable instances of a pod\n"
+          "  genpod             generate a pod spec from namespace limits\n",
+          file=sys.stderr)
+    return 0 if argv and argv[0] in ("-h", "--help") else 1
+
+
+def main() -> None:
+    sys.exit(run())
+
+
+if __name__ == "__main__":
+    main()

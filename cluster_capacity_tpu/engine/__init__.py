@@ -1,0 +1,4 @@
+from .encode import EncodedProblem, encode_problem
+from .simulator import SolveResult, solve
+
+__all__ = ["EncodedProblem", "encode_problem", "SolveResult", "solve"]

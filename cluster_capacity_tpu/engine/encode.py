@@ -1,0 +1,232 @@
+"""Problem encoding: (snapshot, pod template, profile) → device tensors.
+
+This is the TPU-native replacement for the reference's PreFilter machinery: all
+string matching and per-pod precomputation happens once here on the host (the
+analog of the scheduler pre-parsing PodInfo, types.go:602, and each plugin's
+PreFilter), producing fixed-shape arrays the scan engine consumes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..models import podspec as ps
+from ..models.podspec import is_scalar_resource_name
+from ..models.snapshot import (ClusterSnapshot, IDX_CPU, IDX_EPHEMERAL, IDX_MEM,
+                               IDX_PODS)
+from ..ops import (image_locality, inter_pod_affinity, node_affinity, node_name,
+                   node_ports, node_unschedulable, pod_topology_spread,
+                   taint_toleration)
+from ..utils.config import SchedulerProfile
+
+# Per-node failure reason codes (first failing plugin in default filter order:
+# NodeUnschedulable, NodeName, TaintToleration, NodeAffinity, NodePorts,
+# NodeResourcesFit, PodTopologySpread, InterPodAffinity —
+# default_plugins.go:34-51).
+CODE_OK = 0
+CODE_UNSCHEDULABLE = 1
+CODE_NODE_NAME = 2
+CODE_TAINT = 3
+CODE_NODE_AFFINITY = 4
+CODE_PORTS = 5
+CODE_FIT = 6
+CODE_SPREAD_MISSING_LABEL = 7
+CODE_SPREAD = 8
+CODE_IPA_AFFINITY = 9
+CODE_IPA_ANTI = 10
+CODE_IPA_EXISTING_ANTI = 11
+
+STATIC_REASONS = {
+    CODE_UNSCHEDULABLE: node_unschedulable.REASON,
+    CODE_NODE_NAME: node_name.REASON,
+    CODE_NODE_AFFINITY: node_affinity.REASON,
+    CODE_PORTS: node_ports.REASON,
+    CODE_SPREAD_MISSING_LABEL: pod_topology_spread.REASON_MISSING_LABEL,
+    CODE_SPREAD: pod_topology_spread.REASON_CONSTRAINTS,
+    CODE_IPA_AFFINITY: inter_pod_affinity.REASON_AFFINITY,
+    CODE_IPA_ANTI: inter_pod_affinity.REASON_ANTI_AFFINITY,
+    CODE_IPA_EXISTING_ANTI: inter_pod_affinity.REASON_EXISTING_ANTI,
+}
+
+
+@dataclass
+class EncodedProblem:
+    snapshot: ClusterSnapshot
+    pod: dict
+    profile: SchedulerProfile
+
+    # resource axis
+    allocatable: np.ndarray        # f[N, R]
+    init_requested: np.ndarray     # f[N, R]
+    init_nonzero: np.ndarray       # f[N, 2]
+    req_vec: np.ndarray            # f[R] — Filter-path pod request
+    req_nonzero: np.ndarray        # f[2] — (cpu,mem) with 100m/200MB defaults
+
+    # fit score strategy views (indices into resource axis)
+    fit_res_idx: np.ndarray        # i32[K]
+    fit_res_weights: np.ndarray    # f[K]
+    fit_req: np.ndarray            # f[K] — scoring-path request (nonzero defaults)
+    fit_uses_nonzero: np.ndarray   # bool[K] — cpu/mem use NonZeroRequested
+    balanced_res_idx: np.ndarray   # i32[Kb]
+    balanced_req: np.ndarray       # f[Kb] — actual requests
+
+    # static filter state
+    static_mask: np.ndarray        # bool[N]
+    static_code: np.ndarray        # i32[N] — first static fail reason
+    taint_reasons: List[Optional[str]]
+    clone_has_host_ports: bool
+
+    # static score state
+    taint_raw: np.ndarray          # f[N]
+    node_affinity_raw: np.ndarray  # f[N]
+    node_affinity_active: bool
+    image_locality_score: np.ndarray  # f[N]
+
+    # stateful plugins
+    spread_hard: pod_topology_spread.SpreadConstraintSet
+    spread_soft: pod_topology_spread.SpreadConstraintSet
+    spread_ignored: np.ndarray     # bool[N] — score-pass ignored nodes
+    ipa: inter_pod_affinity.AffinityEncoding
+
+    max_steps_hint: int            # fit-based upper bound on placements
+
+
+def encode_problem(snapshot: ClusterSnapshot, pod: dict,
+                   profile: SchedulerProfile) -> EncodedProblem:
+    n = snapshot.num_nodes
+    r = snapshot.num_resources
+
+    # --- pod request vectors ------------------------------------------------
+    reqs = ps.pod_requests(pod)
+    req_vec = np.zeros(r, dtype=np.float64)
+    for name, v in reqs.items():
+        j = snapshot.resource_index(name)
+        if j is not None:
+            req_vec[j] = v
+    req_vec[IDX_PODS] = 1.0
+    cpu_nz, mem_nz = ps.pod_nonzero_cpu_mem(pod)
+    req_nonzero = np.asarray([cpu_nz, mem_nz], dtype=np.float64)
+
+    # --- fit score strategy views ------------------------------------------
+    strat = profile.fit_strategy
+    fit_idx, fit_w, fit_req, fit_nz = [], [], [], []
+    score_reqs = ps.pod_requests(pod, non_missing_defaults=True)
+    for name, w in strat.resources:
+        j = snapshot.resource_index(name)
+        if j is None:
+            continue
+        # calculateResourceAllocatableRequest (resource_allocation.go:88-99):
+        # a scalar/extended resource the pod doesn't request returns (0,0),
+        # dropping it — and its weight — from the node's weighted mean.
+        if is_scalar_resource_name(name) and not score_reqs.get(name, 0):
+            continue
+        fit_idx.append(j)
+        fit_w.append(float(w))
+        fit_req.append(float(score_reqs.get(name, 0)))
+        fit_nz.append(j in (IDX_CPU, IDX_MEM))
+    bal_idx, bal_req = [], []
+    for name, _w in profile.balanced_resources:
+        j = snapshot.resource_index(name)
+        if j is None:
+            continue
+        if is_scalar_resource_name(name) and not reqs.get(name, 0):
+            continue
+        bal_idx.append(j)
+        bal_req.append(float(reqs.get(name, 0)))
+
+    # --- static filters -----------------------------------------------------
+    enabled = profile.filter_enabled
+    masks: List[np.ndarray] = []
+    static_code = np.zeros(n, dtype=np.int32)
+    taint_reasons: List[Optional[str]] = [None] * n
+
+    def fold(mask: np.ndarray, code: int):
+        np.copyto(static_code, code,
+                  where=(static_code == CODE_OK) & ~mask)
+        masks.append(mask)
+
+    if enabled("NodeUnschedulable"):
+        fold(node_unschedulable.static_mask(snapshot, pod), CODE_UNSCHEDULABLE)
+    if enabled("NodeName"):
+        fold(node_name.static_mask(snapshot, pod), CODE_NODE_NAME)
+    if enabled("TaintToleration"):
+        t_mask, taint_reasons = taint_toleration.static_mask_and_reasons(snapshot, pod)
+        fold(t_mask, CODE_TAINT)
+    if enabled("NodeAffinity"):
+        fold(node_affinity.static_mask(snapshot, pod), CODE_NODE_AFFINITY)
+    if enabled("NodePorts"):
+        fold(node_ports.static_mask(snapshot, pod), CODE_PORTS)
+    static_mask = np.logical_and.reduce(masks) if masks else np.ones(n, dtype=bool)
+
+    # --- static scores ------------------------------------------------------
+    taint_raw = taint_toleration.static_raw_score(snapshot, pod) \
+        if profile.score_weight("TaintToleration") else np.zeros(n)
+    na_active = node_affinity.has_preferred_terms(pod)
+    na_raw = node_affinity.static_raw_score(snapshot, pod) \
+        if na_active and profile.score_weight("NodeAffinity") else np.zeros(n)
+    il_score = image_locality.static_score(snapshot, pod) \
+        if profile.score_weight("ImageLocality") else np.zeros(n)
+
+    # --- stateful plugins ---------------------------------------------------
+    if enabled("PodTopologySpread"):
+        spread_hard = pod_topology_spread.encode_constraints(
+            snapshot, pod, "DoNotSchedule")
+    else:
+        spread_hard = pod_topology_spread.encode_constraints(
+            snapshot, {"metadata": pod.get("metadata", {}), "spec": {}},
+            "DoNotSchedule")
+    if profile.score_weight("PodTopologySpread"):
+        spread_soft = pod_topology_spread.encode_constraints(
+            snapshot, pod, "ScheduleAnyway")
+    else:
+        spread_soft = pod_topology_spread.encode_constraints(
+            snapshot, {"metadata": pod.get("metadata", {}), "spec": {}},
+            "ScheduleAnyway")
+    require_all = bool((pod.get("spec") or {}).get("topologySpreadConstraints"))
+    spread_ignored = pod_topology_spread.static_ignored(spread_soft, require_all)
+
+    if enabled("InterPodAffinity") or profile.score_weight("InterPodAffinity"):
+        ipa = inter_pod_affinity.encode(snapshot, pod)
+    else:
+        ipa = inter_pod_affinity.encode(
+            snapshot, {"metadata": pod.get("metadata", {}), "spec": {}})
+
+    # --- scan-length upper bound from the fit filter ------------------------
+    free = snapshot.allocatable - snapshot.requested
+    per_node = np.full(n, np.inf)
+    pod_slots = np.maximum(snapshot.allocatable[:, IDX_PODS]
+                           - snapshot.requested[:, IDX_PODS], 0.0)
+    per_node = np.minimum(per_node, pod_slots)
+    if enabled("NodeResourcesFit"):
+        for j in range(r):
+            if j != IDX_PODS and req_vec[j] > 0:
+                per_node = np.minimum(per_node,
+                                      np.floor(np.maximum(free[:, j], 0.0)
+                                               / req_vec[j]))
+    per_node = np.where(static_mask, per_node, 0.0)
+    hint = int(per_node.sum()) if np.isfinite(per_node.sum()) else 10 ** 6
+
+    return EncodedProblem(
+        snapshot=snapshot, pod=pod, profile=profile,
+        allocatable=snapshot.allocatable, init_requested=snapshot.requested,
+        init_nonzero=snapshot.nonzero_requested,
+        req_vec=req_vec, req_nonzero=req_nonzero,
+        fit_res_idx=np.asarray(fit_idx or [IDX_CPU], dtype=np.int32),
+        fit_res_weights=np.asarray(fit_w or [0.0], dtype=np.float64),
+        fit_req=np.asarray(fit_req or [0.0], dtype=np.float64),
+        fit_uses_nonzero=np.asarray(fit_nz or [False], dtype=bool),
+        balanced_res_idx=np.asarray(bal_idx or [IDX_CPU], dtype=np.int32),
+        balanced_req=np.asarray(bal_req or [0.0], dtype=np.float64),
+        static_mask=static_mask, static_code=static_code,
+        taint_reasons=taint_reasons,
+        clone_has_host_ports=(enabled("NodePorts")
+                              and node_ports.template_has_host_ports(pod)),
+        taint_raw=taint_raw, node_affinity_raw=na_raw,
+        node_affinity_active=na_active, image_locality_score=il_score,
+        spread_hard=spread_hard, spread_soft=spread_soft,
+        spread_ignored=spread_ignored, ipa=ipa,
+        max_steps_hint=hint,
+    )

@@ -1,0 +1,557 @@
+"""The greedy placement engine: one `lax.scan` over the pod sequence.
+
+This replaces the reference's entire event pipeline — scheduling queue, watch
+channels, binder plugin, assume/confirm cache (simulator.go:356-431 +
+schedule_one.go:66-364) — with a single batched solve: the scan carry is the
+cluster's mutable state (requested resources, topology-domain counts), each
+step computes all filter masks and the weighted score pipeline over the full
+node axis, picks the argmax host, and scatter-updates the carry.  Binding is a
+pure array update; there is no async cycle to keep coherent.
+
+Cycle-order parity (schedule_one.go:150-277): filters run in the default
+plugin order, scores are normalized per-cycle over the feasible set, weights
+multiply after normalization (runtime/framework.go:1137-1240), and host
+selection is argmax with lowest-index tie-break (the deterministic replacement
+for selectHost's reservoir sampling, schedule_one.go:894-946) or uniform-among-
+ties when profile.deterministic=False.
+
+Compilation: the scan step is jitted once per (StaticConfig, array shapes) at
+module level, so repeated solves — what-if sweeps, tests over the same cluster
+shape — reuse the compiled executable.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from . import encode as enc
+from ..ops import inter_pod_affinity as ipa_ops
+from ..ops import node_resources_fit as fit_ops
+from ..ops import pod_topology_spread as spread_ops
+
+FAIL_LIMIT_REACHED = "LimitReached"
+FAIL_UNSCHEDULABLE = "Unschedulable"
+
+_DEFAULT_UNLIMITED_CAP = 1_000_000
+
+
+class StaticConfig(NamedTuple):
+    """Everything the jitted step specializes on.  Hashable → usable as a jit
+    static argument, so compilation is cached across solve() calls."""
+
+    dtype64: bool
+    deterministic: bool
+    fit_filter_on: bool
+    clone_has_ports: bool
+    spread_hard_n: int
+    spread_soft_n: int
+    ipa_filter_on: bool
+    ipa_num_aff: int
+    ipa_num_anti: int
+    ipa_num_pref: int
+    ipa_escape_allowed: bool
+    ipa_score_active: bool
+    na_active: bool
+    weights: Tuple[Tuple[str, int], ...]
+    fit_strategy_type: str
+    fit_shape: Tuple[Tuple[float, ...], Tuple[float, ...]]
+
+
+def static_config(pb: enc.EncodedProblem) -> StaticConfig:
+    profile = pb.profile
+    ipa = pb.ipa
+    return StaticConfig(
+        dtype64=(profile.compute_dtype == "float64"),
+        deterministic=profile.deterministic,
+        fit_filter_on=profile.filter_enabled("NodeResourcesFit"),
+        clone_has_ports=pb.clone_has_host_ports,
+        spread_hard_n=pb.spread_hard.num_constraints,
+        spread_soft_n=pb.spread_soft.num_constraints,
+        ipa_filter_on=profile.filter_enabled("InterPodAffinity") and (
+            ipa.num_aff_terms > 0 or ipa.num_anti_terms > 0 or
+            bool(ipa.existing_anti_static.any())),
+        ipa_num_aff=ipa.num_aff_terms,
+        ipa_num_anti=ipa.num_anti_terms,
+        ipa_num_pref=ipa.num_pref_terms,
+        ipa_escape_allowed=ipa.escape_allowed,
+        ipa_score_active=ipa.has_any_score_terms,
+        na_active=pb.node_affinity_active,
+        weights=tuple(sorted(profile.score_weights.items())),
+        fit_strategy_type=profile.fit_strategy.type,
+        fit_shape=(tuple(profile.fit_strategy.shape_utilization),
+                   tuple(profile.fit_strategy.shape_score)),
+    )
+
+
+class Carry(NamedTuple):
+    requested: "jax.Array"          # f[N, R]
+    nonzero: "jax.Array"            # f[N, 2]
+    placed: "jax.Array"             # i32[N]
+    spread_hard: "jax.Array"        # f[Ch, Dh]
+    spread_soft: "jax.Array"        # f[Cs, Ds]
+    aff_dyn: "jax.Array"            # f[G, Da]
+    anti_dyn: "jax.Array"           # f[G, Da]
+    pref_dyn: "jax.Array"           # f[G, Da]
+    placed_count: "jax.Array"       # i32
+    stopped: "jax.Array"            # bool
+    rng: "jax.Array"                # PRNG key (unused when deterministic)
+
+
+@dataclass
+class SolveResult:
+    placements: List[int]                    # node index per placed pod, in order
+    placed_count: int
+    fail_type: str
+    fail_message: str
+    fail_counts: Dict[str, int] = field(default_factory=dict)
+    node_names: List[str] = field(default_factory=list)
+
+    @property
+    def per_node_counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for i in self.placements:
+            name = self.node_names[i]
+            out[name] = out.get(name, 0) + 1
+        return out
+
+
+def _dt(cfg: StaticConfig):
+    import jax.numpy as jnp
+    return jnp.float64 if cfg.dtype64 else jnp.float32
+
+
+def _weight(cfg: StaticConfig, name: str) -> int:
+    for k, v in cfg.weights:
+        if k == name:
+            return v
+    return 0
+
+
+def _default_normalize(raw, feasible, reverse: bool):
+    """helper.DefaultNormalizeScore (normalize_score.go:28-56) over the
+    feasible set: floor(100*s/max); reverse subtracts from 100; max==0 → all
+    100 when reverse else untouched raws."""
+    import jax.numpy as jnp
+    max_s = jnp.max(jnp.where(feasible, raw, 0.0))
+    scaled = jnp.where(max_s > 0,
+                       jnp.floor(100.0 * raw / jnp.where(max_s > 0, max_s, 1.0)),
+                       raw)
+    if reverse:
+        scaled = jnp.where(max_s > 0, 100.0 - scaled, 100.0)
+    return jnp.where(feasible, scaled, 0.0)
+
+
+def build_consts(pb: enc.EncodedProblem) -> Dict[str, "jax.Array"]:
+    """Move all static arrays to device once, in the profile dtype."""
+    import jax.numpy as jnp
+    dt = jnp.float64 if pb.profile.compute_dtype == "float64" else jnp.float32
+    f = lambda a: jnp.asarray(a, dtype=dt)
+    return {
+        "allocatable": f(pb.allocatable),
+        "req_vec": f(pb.req_vec),
+        "req_nonzero": f(pb.req_nonzero),
+        "static_mask": jnp.asarray(pb.static_mask),
+        "taint_raw": f(pb.taint_raw),
+        "na_raw": f(pb.node_affinity_raw),
+        "il_score": f(pb.image_locality_score),
+        "fit_idx": jnp.asarray(pb.fit_res_idx),
+        "fit_w": f(pb.fit_res_weights),
+        "fit_req": f(pb.fit_req),
+        "fit_nz": jnp.asarray(pb.fit_uses_nonzero),
+        "bal_idx": jnp.asarray(pb.balanced_res_idx),
+        "bal_req": f(pb.balanced_req),
+        "sh_dom": jnp.asarray(pb.spread_hard.node_domain),
+        "sh_countable": jnp.asarray(pb.spread_hard.node_countable),
+        "sh_valid": jnp.asarray(pb.spread_hard.domain_valid),
+        "sh_skew": f(pb.spread_hard.max_skew),
+        "sh_mindom": f(pb.spread_hard.min_domains),
+        "sh_self": jnp.asarray(pb.spread_hard.self_match),
+        "sh_init": f(pb.spread_hard.init_counts),
+        "ss_dom": jnp.asarray(pb.spread_soft.node_domain),
+        "ss_countable": jnp.asarray(pb.spread_soft.node_countable),
+        "ss_skew": f(pb.spread_soft.max_skew),
+        "ss_self": jnp.asarray(pb.spread_soft.self_match),
+        "ss_init": f(pb.spread_soft.init_counts),
+        "ss_host": jnp.asarray(pb.spread_soft.is_hostname),
+        "ss_node_existing": f(pb.spread_soft.node_existing),
+        "ss_ignored": jnp.asarray(pb.spread_ignored),
+        "ipa_dom": jnp.asarray(pb.ipa.node_domain),
+        "ipa_aff_group": jnp.asarray(pb.ipa.aff_group),
+        "ipa_anti_group": jnp.asarray(pb.ipa.anti_group),
+        "ipa_pref_group": jnp.asarray(pb.ipa.pref_group),
+        "ipa_aff_init": f(pb.ipa.aff_init),
+        "ipa_anti_init": f(pb.ipa.anti_init),
+        "ipa_self_aff": jnp.asarray(pb.ipa.self_aff_match),
+        "ipa_self_anti": jnp.asarray(pb.ipa.self_anti_match),
+        "ipa_self_pref": jnp.asarray(pb.ipa.self_pref_match),
+        "ipa_pref_w": f(pb.ipa.pref_weight),
+        "ipa_eanti_static": jnp.asarray(pb.ipa.existing_anti_static),
+        "ipa_static_pref": f(pb.ipa.static_pref_score),
+    }
+
+
+def _init_carry(pb: enc.EncodedProblem, consts, seed: int) -> Carry:
+    import jax
+    import jax.numpy as jnp
+    dt = consts["allocatable"].dtype
+    n = pb.snapshot.num_nodes
+    g = pb.ipa.node_domain.shape[0]
+    d = pb.ipa.max_domains
+    return Carry(
+        requested=jnp.asarray(pb.init_requested, dtype=dt),
+        nonzero=jnp.asarray(pb.init_nonzero, dtype=dt),
+        placed=jnp.zeros(n, dtype=jnp.int32),
+        spread_hard=consts["sh_init"],
+        spread_soft=consts["ss_init"],
+        aff_dyn=jnp.zeros((g, d), dtype=dt),
+        anti_dyn=jnp.zeros((g, d), dtype=dt),
+        pref_dyn=jnp.zeros((g, d), dtype=dt),
+        placed_count=jnp.zeros((), dtype=jnp.int32),
+        stopped=jnp.zeros((), dtype=bool),
+        rng=jax.random.PRNGKey(seed),
+    )
+
+
+def _feasibility(cfg: StaticConfig, consts, carry: Carry):
+    """All filter masks for the current state.  Returns (feasible, parts dict
+    for diagnosis)."""
+    feasible = consts["static_mask"]
+    parts = {}
+
+    if cfg.fit_filter_on:
+        fitv = fit_ops.fit_filter(consts["allocatable"], carry.requested,
+                                  consts["req_vec"])
+        parts["fit"] = fitv
+        feasible = feasible & fitv.mask
+
+    if cfg.clone_has_ports:
+        ports_ok = ~(carry.placed > 0)
+        parts["ports_dyn"] = ports_ok
+        feasible = feasible & ports_ok
+
+    if cfg.spread_hard_n > 0:
+        sp_ok, sp_missing = spread_ops.hard_filter(
+            carry.spread_hard, consts["sh_dom"], consts["sh_valid"],
+            consts["sh_skew"], consts["sh_mindom"], consts["sh_self"])
+        parts["spread_ok"] = sp_ok
+        parts["spread_missing"] = sp_missing
+        feasible = feasible & sp_ok
+
+    if cfg.ipa_filter_on:
+        eanti_dyn = ipa_ops.existing_anti_dynamic_fail(
+            carry.anti_dyn, consts["ipa_dom"], consts["ipa_anti_group"],
+            cfg.ipa_num_anti)
+        ok, f_aff, f_anti, f_eanti = ipa_ops.filter_all(
+            consts["ipa_aff_init"] + carry.aff_dyn,
+            consts["ipa_anti_init"] + carry.anti_dyn,
+            consts["ipa_dom"], consts["ipa_aff_group"],
+            consts["ipa_anti_group"], cfg.ipa_num_aff, cfg.ipa_num_anti,
+            cfg.ipa_escape_allowed, consts["ipa_eanti_static"], eanti_dyn)
+        parts["ipa"] = (f_aff, f_anti, f_eanti)
+        feasible = feasible & ok
+    return feasible, parts
+
+
+def _scores(cfg: StaticConfig, consts, carry: Carry, feasible):
+    import jax.numpy as jnp
+    dt = _dt(cfg)
+    n = consts["static_mask"].shape[0]
+    total = jnp.zeros(n, dtype=dt)
+
+    w = _weight(cfg, "NodeResourcesFit")
+    if w:
+        alloc = consts["allocatable"][:, consts["fit_idx"]]
+        req = carry.requested[:, consts["fit_idx"]]
+        # cpu/mem use NonZeroRequested (resource_allocation.go:85-91)
+        nz_col = jnp.where(consts["fit_idx"] == 1, 0, 1)
+        nz_vals = carry.nonzero[:, nz_col]
+        req = jnp.where(consts["fit_nz"][None, :], nz_vals, req)
+        req = req + consts["fit_req"][None, :]
+        if cfg.fit_strategy_type == "MostAllocated":
+            s = fit_ops.most_allocated_score(alloc, req, consts["fit_w"])
+        elif cfg.fit_strategy_type == "RequestedToCapacityRatio":
+            s = fit_ops.requested_to_capacity_ratio_score(
+                alloc, req, consts["fit_w"], cfg.fit_shape[0], cfg.fit_shape[1])
+        else:
+            s = fit_ops.least_allocated_score(alloc, req, consts["fit_w"])
+        total = total + w * jnp.where(feasible, s, 0.0)
+
+    w = _weight(cfg, "NodeResourcesBalancedAllocation")
+    if w:
+        alloc = consts["allocatable"][:, consts["bal_idx"]]
+        req = carry.requested[:, consts["bal_idx"]] + consts["bal_req"][None, :]
+        s = fit_ops.balanced_allocation_score(alloc, req)
+        total = total + w * jnp.where(feasible, s, 0.0)
+
+    w = _weight(cfg, "TaintToleration")
+    if w:
+        total = total + w * _default_normalize(consts["taint_raw"], feasible,
+                                               reverse=True)
+
+    w = _weight(cfg, "NodeAffinity")
+    if w and cfg.na_active:
+        total = total + w * _default_normalize(consts["na_raw"], feasible,
+                                               reverse=False)
+
+    w = _weight(cfg, "ImageLocality")
+    if w:
+        total = total + w * jnp.where(feasible, consts["il_score"], 0.0)
+
+    w = _weight(cfg, "PodTopologySpread")
+    if w and cfg.spread_soft_n > 0:
+        node_dyn = consts["ss_node_existing"] + \
+            jnp.where(consts["ss_self"][:, None],
+                      carry.placed[None, :].astype(dt), 0.0)
+        raw, scored = spread_ops.soft_score(
+            carry.spread_soft, node_dyn, consts["ss_dom"], consts["ss_host"],
+            consts["ss_skew"], consts["ss_ignored"], feasible)
+        total = total + w * spread_ops.soft_normalize(raw, scored)
+
+    w = _weight(cfg, "InterPodAffinity")
+    if w and cfg.ipa_score_active:
+        raw = ipa_ops.pref_score(carry.pref_dyn, consts["ipa_dom"],
+                                 consts["ipa_pref_group"],
+                                 consts["ipa_static_pref"], cfg.ipa_num_pref)
+        total = total + w * ipa_ops.normalize(raw, feasible, True)
+
+    return total
+
+
+def _step(cfg: StaticConfig, consts, carry: Carry):
+    import jax
+    import jax.numpy as jnp
+    dt = _dt(cfg)
+
+    feasible, _parts = _feasibility(cfg, consts, carry)
+    any_feasible = jnp.any(feasible)
+    total = _scores(cfg, consts, carry, feasible)
+
+    neg_one = jnp.asarray(-1.0, dt)
+    keyed = jnp.where(feasible, total, neg_one)
+    if cfg.deterministic:
+        chosen = jnp.argmax(keyed).astype(jnp.int32)
+        rng = carry.rng
+    else:
+        rng, sub = jax.random.split(carry.rng)
+        jitter = jax.random.uniform(sub, keyed.shape, dtype=jnp.float32)
+        # integer scores: +0.5*U(0,1) breaks ties uniformly (the stationary
+        # equivalent of selectHost's reservoir sampling) without reordering
+        # distinct scores.
+        chosen = jnp.argmax(keyed + 0.5 * jitter.astype(dt)).astype(jnp.int32)
+
+    place = any_feasible & ~carry.stopped
+    gate = place.astype(dt)
+
+    requested = carry.requested.at[chosen].add(gate * consts["req_vec"])
+    nonzero = carry.nonzero.at[chosen].add(gate * consts["req_nonzero"])
+    placed = carry.placed.at[chosen].add(place.astype(jnp.int32))
+
+    spread_hard = carry.spread_hard
+    if cfg.spread_hard_n > 0:
+        upd = spread_ops.placement_update(
+            carry.spread_hard, consts["sh_dom"], consts["sh_countable"],
+            consts["sh_self"], chosen)
+        spread_hard = jnp.where(place, upd, carry.spread_hard)
+    spread_soft = carry.spread_soft
+    if cfg.spread_soft_n > 0:
+        upd = spread_ops.placement_update(
+            carry.spread_soft, consts["ss_dom"], consts["ss_countable"],
+            consts["ss_self"], chosen)
+        spread_soft = jnp.where(place, upd, carry.spread_soft)
+
+    aff_dyn, anti_dyn, pref_dyn = carry.aff_dyn, carry.anti_dyn, carry.pref_dyn
+    if cfg.ipa_num_aff > 0:
+        upd = ipa_ops.placement_update(
+            carry.aff_dyn, consts["ipa_dom"], consts["ipa_aff_group"],
+            consts["ipa_self_aff"], chosen)
+        aff_dyn = jnp.where(place, upd, carry.aff_dyn)
+    if cfg.ipa_num_anti > 0:
+        upd = ipa_ops.placement_update(
+            carry.anti_dyn, consts["ipa_dom"], consts["ipa_anti_group"],
+            consts["ipa_self_anti"], chosen)
+        anti_dyn = jnp.where(place, upd, carry.anti_dyn)
+    if cfg.ipa_num_pref > 0:
+        # Both directions of processExistingPod apply between identical clones
+        # → 2x the term weight per placement (scoring.go:121-160).
+        upd = ipa_ops.placement_update(
+            carry.pref_dyn, consts["ipa_dom"], consts["ipa_pref_group"],
+            consts["ipa_self_pref"], chosen,
+            weight=2.0 * consts["ipa_pref_w"])
+        pref_dyn = jnp.where(place, upd, carry.pref_dyn)
+
+    new_carry = Carry(
+        requested=requested, nonzero=nonzero, placed=placed,
+        spread_hard=spread_hard, spread_soft=spread_soft,
+        aff_dyn=aff_dyn, anti_dyn=anti_dyn, pref_dyn=pref_dyn,
+        placed_count=carry.placed_count + place.astype(jnp.int32),
+        stopped=carry.stopped | ~any_feasible,
+        rng=rng,
+    )
+    return new_carry, jnp.where(place, chosen, -1)
+
+
+@functools.lru_cache(maxsize=None)
+def _chunk_runner():
+    """Module-level jitted scan, cached once; jit's own cache then reuses
+    compiled executables across solves keyed on (cfg, shapes, n)."""
+    import jax
+
+    @functools.partial(jax.jit, static_argnames=("cfg", "n"))
+    def run_chunk(cfg: StaticConfig, consts, carry: Carry, n: int):
+        def body(c, _):
+            return _step(cfg, consts, c)
+        return jax.lax.scan(body, carry, None, length=n)
+
+    return run_chunk
+
+
+def _ensure_x64(profile):
+    import jax
+    if profile.compute_dtype == "float64" and not jax.config.jax_enable_x64:
+        # Parity mode promises bit-exact int64 score math; float32 silently
+        # breaks it near capacity boundaries.  Enable x64 for the process.
+        jax.config.update("jax_enable_x64", True)
+
+
+def solve(pb: enc.EncodedProblem, max_limit: int = 0,
+          chunk_size: int = 1024) -> SolveResult:
+    """Run the greedy placement loop to completion.
+
+    The scan runs in fixed-size chunks of a jitted `lax.scan`; chunks repeat
+    until the carry reports a stop or the step budget is exhausted."""
+    import jax
+    import numpy as np
+
+    if pb.snapshot.num_nodes == 0:
+        return SolveResult(placements=[], placed_count=0,
+                           fail_type=FAIL_UNSCHEDULABLE,
+                           fail_message="0/0 nodes are available",
+                           node_names=[])
+
+    _ensure_x64(pb.profile)
+    cfg = static_config(pb)
+    consts = build_consts(pb)
+    carry = _init_carry(pb, consts, pb.profile.seed)
+    run_chunk = _chunk_runner()
+
+    budget = pb.max_steps_hint + 1
+    if max_limit and max_limit > 0:
+        budget = min(max_limit, budget)
+    budget = max(1, min(budget, _DEFAULT_UNLIMITED_CAP))
+
+    placements: List[int] = []
+    steps_done = 0
+    while steps_done < budget:
+        n = min(chunk_size, budget - steps_done)
+        carry, chosen = run_chunk(cfg, consts, carry, n)
+        chosen = np.asarray(chosen)
+        for c in chosen:
+            if c >= 0:
+                placements.append(int(c))
+        steps_done += n
+        if bool(np.asarray(carry.stopped)):
+            break
+
+    placed = len(placements)
+    stopped = bool(np.asarray(carry.stopped))
+
+    if stopped:
+        counts = diagnose(pb, cfg, consts, carry)
+        msg = format_fit_error(pb.snapshot.num_nodes, counts)
+        return SolveResult(placements=placements, placed_count=placed,
+                           fail_type=FAIL_UNSCHEDULABLE, fail_message=msg,
+                           fail_counts=counts,
+                           node_names=pb.snapshot.node_names)
+    if max_limit and placed >= max_limit:
+        # postBindHook limit semantics (simulator.go:297-312).
+        return SolveResult(placements=placements, placed_count=placed,
+                           fail_type=FAIL_LIMIT_REACHED,
+                           fail_message=f"Maximum number of pods simulated: {max_limit}",
+                           node_names=pb.snapshot.node_names)
+    # Internal step budget exhausted without a user limit (only reachable when
+    # the fit filter is disabled, so the hint bound is not authoritative).
+    return SolveResult(placements=placements, placed_count=placed,
+                       fail_type=FAIL_LIMIT_REACHED,
+                       fail_message=(f"Simulation step budget exhausted after "
+                                     f"{placed} placements; set max_limit to "
+                                     f"bound unlimited profiles"),
+                       node_names=pb.snapshot.node_names)
+
+
+def diagnose(pb: enc.EncodedProblem, cfg: StaticConfig, consts,
+             carry: Carry) -> Dict[str, int]:
+    """Per-reason node counts at the stopping state — the tensor equivalent of
+    the FitError reasons histogram (types.go:787-828).  Each infeasible node
+    contributes the reason(s) of its first failing plugin in filter order; the
+    fit plugin contributes every insufficient resource (fit.go:564-660)."""
+    feasible, parts = _feasibility(cfg, consts, carry)
+    n = pb.snapshot.num_nodes
+    static_code = np.asarray(pb.static_code)
+
+    fit = parts.get("fit")
+    fit_fail = ~np.asarray(fit.mask) if fit is not None else np.zeros(n, bool)
+    insufficient = np.asarray(fit.insufficient) if fit is not None else None
+    too_many = np.asarray(fit.too_many_pods) if fit is not None else None
+    ports_dyn_fail = ~np.asarray(parts["ports_dyn"]) if "ports_dyn" in parts \
+        else np.zeros(n, bool)
+    spread_ok = np.asarray(parts.get("spread_ok", np.ones(n, bool)))
+    spread_missing = np.asarray(parts.get("spread_missing", np.zeros(n, bool)))
+    if "ipa" in parts:
+        f_aff, f_anti, f_eanti = (np.asarray(x) for x in parts["ipa"])
+    else:
+        f_aff = f_anti = f_eanti = np.zeros(n, bool)
+
+    counts: Dict[str, int] = {}
+
+    def add(reason: str, k: int = 1):
+        counts[reason] = counts.get(reason, 0) + k
+
+    for i in range(n):
+        if static_code[i] != enc.CODE_OK:
+            code = int(static_code[i])
+            if code == enc.CODE_TAINT:
+                add(pb.taint_reasons[i] or "node(s) had untolerated taint")
+            else:
+                add(enc.STATIC_REASONS[code])
+            continue
+        if ports_dyn_fail[i]:
+            add(enc.STATIC_REASONS[enc.CODE_PORTS])
+            continue
+        if fit_fail[i]:
+            if too_many is not None and too_many[i]:
+                add("Too many pods")
+            if insufficient is not None:
+                for j, rname in enumerate(pb.snapshot.resource_names):
+                    if insufficient[i, j]:
+                        add(f"Insufficient {rname}")
+            continue
+        if spread_missing[i]:
+            add(enc.STATIC_REASONS[enc.CODE_SPREAD_MISSING_LABEL])
+            continue
+        if not spread_ok[i]:
+            add(enc.STATIC_REASONS[enc.CODE_SPREAD])
+            continue
+        if f_aff[i]:
+            add(enc.STATIC_REASONS[enc.CODE_IPA_AFFINITY])
+            continue
+        if f_anti[i]:
+            add(enc.STATIC_REASONS[enc.CODE_IPA_ANTI])
+            continue
+        if f_eanti[i]:
+            add(enc.STATIC_REASONS[enc.CODE_IPA_EXISTING_ANTI])
+            continue
+    return counts
+
+
+def format_fit_error(num_nodes: int, counts: Dict[str, int]) -> str:
+    """FitError.Error() (types.go:787-828): '0/N nodes are available: '
+    + lexicographically-sorted '<count> <reason>' strings + '.'"""
+    reason_strings = sorted(f"{v} {k}" for k, v in counts.items())
+    msg = f"0/{num_nodes} nodes are available"
+    if reason_strings:
+        msg += ": " + ", ".join(reason_strings) + "."
+    return msg

@@ -1,0 +1,82 @@
+"""Top-level simulation facade.
+
+Mirrors the reference's `pkg/framework` public surface
+(/root/reference/pkg/framework/simulator.go:107-381): construct with a pod
+template + scheduler profile, feed it cluster state, run, read the report.
+Instead of a fake API server + informers + a live scheduler, `run()` encodes
+the snapshot to device tensors and executes the scan engine.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from .engine.encode import encode_problem
+from .engine.simulator import SolveResult, solve
+from .models.podspec import default_pod, load_pod_yaml, parse_pod_text, validate_pod
+from .models.snapshot import ClusterSnapshot
+from .utils.config import SchedulerProfile, load_scheduler_config
+from .utils.report import ClusterCapacityReview, build_review, print_review
+
+
+class ClusterCapacity:
+    """framework.New equivalent (simulator.go:107-158)."""
+
+    def __init__(self, pod: dict, max_limit: int = 0,
+                 profile: Optional[SchedulerProfile] = None,
+                 exclude_nodes: Sequence[str] = ()):
+        self.pod = pod
+        self.max_limit = max_limit
+        self.profile = profile or SchedulerProfile()
+        self.exclude_nodes = list(exclude_nodes)
+        self.snapshot: Optional[ClusterSnapshot] = None
+        self._result: Optional[SolveResult] = None
+
+    def sync_with_objects(self, nodes: Sequence[dict],
+                          pods: Sequence[dict] = (), **extra) -> None:
+        """SyncWithClient equivalent (simulator.go:176-295) over already-fetched
+        objects; `extra` takes services/pvcs/pdbs/… keyword lists."""
+        self.snapshot = ClusterSnapshot.from_objects(
+            nodes, pods, exclude_nodes=self.exclude_nodes, **extra)
+
+    def sync_with_client(self, client) -> None:
+        """SyncWithClient over a live kubernetes.client-compatible API object
+        (duck-typed; anything exposing list_node/list_pod_for_all_namespaces)."""
+        nodes = [_to_dict(x) for x in client.list_node().items]
+        pods = [_to_dict(x) for x in client.list_pod_for_all_namespaces().items]
+        self.sync_with_objects(nodes, pods)
+
+    def run(self) -> SolveResult:
+        if self.snapshot is None:
+            raise RuntimeError("call sync_with_objects/sync_with_client first")
+        problem = encode_problem(self.snapshot, self.pod, self.profile)
+        self._result = solve(problem, max_limit=self.max_limit)
+        return self._result
+
+    def report(self) -> ClusterCapacityReview:
+        if self._result is None:
+            raise RuntimeError("call run() first")
+        return build_review([self.pod], self._result)
+
+
+def _to_dict(obj):
+    if isinstance(obj, dict):
+        return obj
+    to_dict = getattr(obj, "to_dict", None)
+    if to_dict:
+        return _camelize(to_dict())
+    raise TypeError(f"cannot convert {type(obj)} to dict")
+
+
+def _camelize(obj):
+    """kubernetes-client python dicts use snake_case keys; convert back."""
+    if isinstance(obj, dict):
+        out = {}
+        for k, v in obj.items():
+            parts = k.split("_")
+            key = parts[0] + "".join(p.title() for p in parts[1:])
+            out[key] = _camelize(v)
+        return out
+    if isinstance(obj, list):
+        return [_camelize(x) for x in obj]
+    return obj

@@ -1,0 +1,5 @@
+from .snapshot import ClusterSnapshot
+from .podspec import default_pod, load_pod_yaml, parse_pod_text, validate_pod
+
+__all__ = ["ClusterSnapshot", "default_pod", "load_pod_yaml",
+           "parse_pod_text", "validate_pod"]

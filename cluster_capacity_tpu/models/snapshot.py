@@ -1,0 +1,190 @@
+"""Tensorized cluster snapshot — the framework's core data model.
+
+The reference copies live-cluster objects into an in-memory fake API server and
+lets informers feed a real scheduler (SyncWithClient,
+/root/reference/pkg/framework/simulator.go:176-295).  Here the snapshot is a set
+of host numpy arrays over a fixed node axis; the engine moves them to device
+once per solve.  NodeInfo semantics mirrored:
+- per-node Requested / NonZeroRequested / Allocatable resource vectors
+  (vendor/.../scheduler/framework/types.go:160-200,940-948)
+- pod rosters kept as python lists for host-side precomputation only.
+
+Resource axis layout: index 0=pods, 1=cpu (milli), 2=memory (bytes),
+3=ephemeral-storage (bytes), 4..=scalar resource vocabulary (sorted names).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .podspec import (RES_CPU, RES_EPHEMERAL, RES_MEMORY, RES_PODS,
+                      is_scalar_resource_name, pod_host_ports,
+                      pod_nonzero_cpu_mem, pod_requests)
+from ..utils.quantity import int_value, milli_value
+
+IDX_PODS = 0
+IDX_CPU = 1
+IDX_MEM = 2
+IDX_EPHEMERAL = 3
+N_BASE_RESOURCES = 4
+
+_TERMINAL_PHASES = ("Succeeded", "Failed")
+
+
+def _parse_allocatable(alloc: Mapping) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for name, q in (alloc or {}).items():
+        out[name] = milli_value(q) if name == RES_CPU else int_value(q)
+    return out
+
+
+@dataclass
+class ClusterSnapshot:
+    """Immutable snapshot of cluster state over a fixed node axis."""
+
+    nodes: List[dict]                      # node objects, in node-axis order
+    node_names: List[str]
+    resource_names: List[str]              # resource-axis vocabulary
+    allocatable: np.ndarray                # f64[N, R]
+    requested: np.ndarray                  # f64[N, R] incl. pod count at IDX_PODS
+    nonzero_requested: np.ndarray          # f64[N, 2] (cpu milli, mem bytes)
+    pods_by_node: List[List[dict]]         # existing (non-terminal) pods per node
+    # objects synced for API parity with SyncWithClient (simulator.go:176-295);
+    # consumed by volume plugins / genpod when implemented.
+    services: List[dict] = field(default_factory=list)
+    pvcs: List[dict] = field(default_factory=list)
+    pdbs: List[dict] = field(default_factory=list)
+    replication_controllers: List[dict] = field(default_factory=list)
+    replica_sets: List[dict] = field(default_factory=list)
+    stateful_sets: List[dict] = field(default_factory=list)
+    storage_classes: List[dict] = field(default_factory=list)
+    namespaces: List[dict] = field(default_factory=list)
+
+    @property
+    def num_nodes(self) -> int:
+        return len(self.nodes)
+
+    @property
+    def num_resources(self) -> int:
+        return len(self.resource_names)
+
+    def resource_index(self, name: str) -> Optional[int]:
+        try:
+            return self.resource_names.index(name)
+        except ValueError:
+            return None
+
+    def node_labels(self, i: int) -> Mapping[str, str]:
+        return (self.nodes[i].get("metadata") or {}).get("labels") or {}
+
+    def node_taints(self, i: int) -> Sequence[Mapping]:
+        return (self.nodes[i].get("spec") or {}).get("taints") or []
+
+    def node_unschedulable(self, i: int) -> bool:
+        return bool((self.nodes[i].get("spec") or {}).get("unschedulable"))
+
+    def node_images(self, i: int) -> Dict[str, int]:
+        """Normalized image name → sizeBytes for node i (NodeInfo.ImageStates)."""
+        out: Dict[str, int] = {}
+        for img in ((self.nodes[i].get("status") or {}).get("images") or []):
+            size = int(img.get("sizeBytes", 0))
+            for name in img.get("names") or []:
+                out[_normalize_image(name)] = size
+        return out
+
+    def node_used_host_ports(self, i: int) -> List[Tuple[str, str, int]]:
+        out = []
+        for pod in self.pods_by_node[i]:
+            out.extend(pod_host_ports(pod))
+        return out
+
+    @classmethod
+    def from_objects(cls, nodes: Sequence[Mapping],
+                     pods: Sequence[Mapping] = (),
+                     exclude_nodes: Sequence[str] = (),
+                     sort_nodes: bool = True,
+                     **extra_objects) -> "ClusterSnapshot":
+        """Build a snapshot the way SyncWithClient does: skip excluded nodes
+        (simulator.go:209), drop terminal pods (:196), pivot pods onto their
+        nodes (NewSnapshot, backend/cache/snapshot.go:86-107).
+
+        Nodes are sorted by name by default for deterministic node-axis order
+        (the parity-mode replacement for the reference's zone round-robin
+        node_tree ordering)."""
+        excluded = set(exclude_nodes)
+        node_list = [dict(n) for n in nodes
+                     if (n.get("metadata") or {}).get("name") not in excluded]
+        if sort_nodes:
+            node_list.sort(key=lambda n: (n.get("metadata") or {}).get("name", ""))
+        names = [(n.get("metadata") or {}).get("name", "") for n in node_list]
+        index_of = {name: i for i, name in enumerate(names)}
+
+        pods_by_node: List[List[dict]] = [[] for _ in node_list]
+        for pod in pods:
+            phase = ((pod.get("status") or {}).get("phase")) or ""
+            if phase in _TERMINAL_PHASES:
+                continue
+            node_name = (pod.get("spec") or {}).get("nodeName") or ""
+            if node_name in index_of:
+                pods_by_node[index_of[node_name]].append(dict(pod))
+
+        # Resource vocabulary: base + scalars seen in allocatable or requests.
+        scalars = set()
+        alloc_maps = []
+        for n in node_list:
+            am = _parse_allocatable((n.get("status") or {}).get("allocatable"))
+            alloc_maps.append(am)
+            scalars.update(k for k in am if is_scalar_resource_name(k))
+        req_maps: List[Dict[str, int]] = []
+        for plist in pods_by_node:
+            agg: Dict[str, int] = {}
+            for pod in plist:
+                for k, v in pod_requests(pod).items():
+                    agg[k] = agg.get(k, 0) + v
+            req_maps.append(agg)
+            scalars.update(k for k in agg if is_scalar_resource_name(k))
+        resource_names = [RES_PODS, RES_CPU, RES_MEMORY, RES_EPHEMERAL] + sorted(scalars)
+        r_index = {r: i for i, r in enumerate(resource_names)}
+
+        n_nodes, n_res = len(node_list), len(resource_names)
+        allocatable = np.zeros((n_nodes, n_res), dtype=np.float64)
+        requested = np.zeros((n_nodes, n_res), dtype=np.float64)
+        nonzero = np.zeros((n_nodes, 2), dtype=np.float64)
+        for i in range(n_nodes):
+            for k, v in alloc_maps[i].items():
+                j = r_index.get(k)
+                if j is not None:
+                    allocatable[i, j] = v
+            for k, v in req_maps[i].items():
+                j = r_index.get(k)
+                if j is not None:
+                    requested[i, j] = v
+            requested[i, IDX_PODS] = len(pods_by_node[i])
+            for pod in pods_by_node[i]:
+                cpu, mem = pod_nonzero_cpu_mem(pod)
+                nonzero[i, 0] += cpu
+                nonzero[i, 1] += mem
+
+        return cls(nodes=node_list, node_names=names,
+                   resource_names=resource_names, allocatable=allocatable,
+                   requested=requested, nonzero_requested=nonzero,
+                   pods_by_node=pods_by_node,
+                   services=list(extra_objects.get("services", ())),
+                   pvcs=list(extra_objects.get("pvcs", ())),
+                   pdbs=list(extra_objects.get("pdbs", ())),
+                   replication_controllers=list(
+                       extra_objects.get("replication_controllers", ())),
+                   replica_sets=list(extra_objects.get("replica_sets", ())),
+                   stateful_sets=list(extra_objects.get("stateful_sets", ())),
+                   storage_classes=list(extra_objects.get("storage_classes", ())),
+                   namespaces=list(extra_objects.get("namespaces", ())))
+
+
+def _normalize_image(name: str) -> str:
+    """CRI image-name normalization (image_locality.go:120-127)."""
+    if name.rfind(":") <= name.rfind("/"):
+        name = name + ":latest"
+    return name

@@ -1,0 +1,2 @@
+"""Scheduler plugin kernels (the reference's plugin library, SURVEY.md §2c,
+re-expressed as host precomputes + pure JAX device functions)."""

@@ -1,0 +1,372 @@
+"""InterPodAffinity: filter + score as carried topology-pair count tensors.
+
+Reference semantics (/root/reference/vendor/k8s.io/kubernetes/pkg/scheduler/framework/plugins/interpodaffinity/):
+- PreFilter (filtering.go:91-310) builds three (topologyKey,value)→count maps:
+  affinityCounts / antiAffinityCounts for the incoming pod's required terms vs
+  existing pods, and existingAntiAffinityCounts for existing pods' required
+  anti-affinity terms vs the incoming pod.
+- Filter (filtering.go:352-433) is three hash probes, in order: pod affinity
+  (UnschedulableAndUnresolvable, with the lonely-pod self-match escape hatch at
+  :400-406), pod anti-affinity, existing-pods anti-affinity.
+- Score (scoring.go:100-300): weighted preferred terms, both directions
+  (incoming↔existing), min-max normalized.
+
+TPU design: terms are grouped by topologyKey; each group's (value→count) map
+becomes one row of a `[G, D]` tensor carried through the scan.  Because clones
+are identical, every placement's increment is a static per-term boolean
+(`self_match`) — the dynamic update is a one-hot scatter at the chosen node's
+domain.  The merged-map semantics (counts shared between terms with the same
+topologyKey) are preserved exactly.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.labels import match_label_selector
+from ..models.snapshot import ClusterSnapshot
+
+REASON_AFFINITY = "node(s) didn't match pod affinity rules"
+REASON_ANTI_AFFINITY = "node(s) didn't match pod anti-affinity rules"
+REASON_EXISTING_ANTI = "node(s) didn't satisfy existing pods anti-affinity rules"
+
+
+def _term_namespaces(term: Mapping, owner_ns: str) -> Tuple[set, Optional[Mapping]]:
+    """getNamespacesFromPodAffinityTerm: explicit namespaces, else the owner's
+    namespace when no namespaceSelector is given."""
+    namespaces = set(term.get("namespaces") or [])
+    ns_selector = term.get("namespaceSelector")
+    if not namespaces and ns_selector is None:
+        namespaces = {owner_ns}
+    return namespaces, ns_selector
+
+
+def _ns_labels_map(snapshot: ClusterSnapshot) -> Dict[str, Mapping[str, str]]:
+    out = {}
+    for ns in snapshot.namespaces:
+        meta = ns.get("metadata") or {}
+        out[meta.get("name", "")] = meta.get("labels") or {}
+    return out
+
+
+def _term_matches_pod(term: Mapping, owner_ns: str, candidate: Mapping,
+                      ns_labels: Dict[str, Mapping[str, str]]) -> bool:
+    """AffinityTerm.Matches: namespace membership (list or selector) AND label
+    selector match against the candidate pod."""
+    meta = candidate.get("metadata") or {}
+    cand_ns = meta.get("namespace") or "default"
+    namespaces, ns_selector = _term_namespaces(term, owner_ns)
+    ns_ok = cand_ns in namespaces or (
+        ns_selector is not None and
+        match_label_selector(ns_selector, ns_labels.get(cand_ns, {})))
+    if not ns_ok:
+        return False
+    return match_label_selector(term.get("labelSelector"), meta.get("labels") or {})
+
+
+def _required_terms(pod: Mapping, kind: str) -> List[Mapping]:
+    aff = (pod.get("spec") or {}).get("affinity") or {}
+    section = aff.get(kind) or {}
+    return section.get("requiredDuringSchedulingIgnoredDuringExecution") or []
+
+
+def _preferred_terms(pod: Mapping, kind: str) -> List[Mapping]:
+    aff = (pod.get("spec") or {}).get("affinity") or {}
+    section = aff.get(kind) or {}
+    return section.get("preferredDuringSchedulingIgnoredDuringExecution") or []
+
+
+@dataclass
+class AffinityEncoding:
+    """Everything InterPodAffinity needs on device for one template."""
+
+    # --- required terms, grouped by topologyKey -------------------------
+    num_aff_terms: int
+    num_anti_terms: int
+    max_domains: int
+    aff_group: np.ndarray        # i32[Ta] — group row per affinity term
+    anti_group: np.ndarray       # i32[Tn]
+    group_keys: List[str]        # key per group row (shared aff+anti vocab)
+    node_domain: np.ndarray      # i32[G, N] — -1 when node lacks group key
+    aff_init: np.ndarray         # f64[G, D] — merged affinityCounts
+    anti_init: np.ndarray        # f64[G, D] — merged antiAffinityCounts
+    self_aff_match: np.ndarray   # bool[Ta] — clone matches term (ns+selector)
+    self_anti_match: np.ndarray  # bool[Tn]
+    escape_allowed: bool         # template matches ALL its own affinity terms
+    existing_anti_static: np.ndarray  # bool[N] — existing pods' anti-affinity blocks
+    # --- preferred terms (score) ---------------------------------------
+    num_pref_terms: int
+    pref_group: np.ndarray       # i32[Tp] — group row per preferred term
+    pref_weight: np.ndarray      # f64[Tp] — signed (anti terms negative)
+    self_pref_match: np.ndarray  # bool[Tp]
+    static_pref_score: np.ndarray  # f64[N] — existing-pod contributions
+    has_any_score_terms: bool    # static_pref nonzero or dynamic terms exist
+
+    @property
+    def active(self) -> bool:
+        return (self.num_aff_terms + self.num_anti_terms +
+                self.num_pref_terms) > 0 or \
+            bool(self.existing_anti_static.any()) or \
+            bool(np.any(self.static_pref_score != 0.0))
+
+
+def encode(snapshot: ClusterSnapshot, pod: Mapping) -> AffinityEncoding:
+    n = snapshot.num_nodes
+    meta = pod.get("metadata") or {}
+    owner_ns = meta.get("namespace") or "default"
+    pod_self = {"metadata": {"namespace": owner_ns,
+                             "labels": meta.get("labels") or {}}}
+    ns_labels = _ns_labels_map(snapshot)
+
+    aff_terms = _required_terms(pod, "podAffinity")
+    anti_terms = _required_terms(pod, "podAntiAffinity")
+    pref_aff = _preferred_terms(pod, "podAffinity")
+    pref_anti = _preferred_terms(pod, "podAntiAffinity")
+
+    # Group vocabulary over topology keys used by any term.
+    keys: List[str] = []
+    def group_of(key: str) -> int:
+        if key not in keys:
+            keys.append(key)
+        return keys.index(key)
+
+    aff_group = np.asarray([group_of(t.get("topologyKey", "")) for t in aff_terms],
+                           dtype=np.int32)
+    anti_group = np.asarray([group_of(t.get("topologyKey", "")) for t in anti_terms],
+                            dtype=np.int32)
+    pref_terms = [(t.get("podAffinityTerm") or {}, float(t.get("weight", 0)))
+                  for t in pref_aff] + \
+                 [(t.get("podAffinityTerm") or {}, -float(t.get("weight", 0)))
+                  for t in pref_anti]
+    pref_group = np.asarray([group_of(t.get("topologyKey", ""))
+                             for t, _ in pref_terms], dtype=np.int32)
+
+    g = max(len(keys), 1)
+    # Domain vocab per group.
+    node_domain = np.full((g, n), -1, dtype=np.int32)
+    vocabs: List[dict] = [dict() for _ in range(g)]
+    for gi, key in enumerate(keys):
+        for i in range(n):
+            val = snapshot.node_labels(i).get(key)
+            if val is None:
+                continue
+            if val not in vocabs[gi]:
+                vocabs[gi][val] = len(vocabs[gi])
+            node_domain[gi, i] = vocabs[gi][val]
+    d_max = max(max((len(v) for v in vocabs), default=0), 1)
+
+    aff_init = np.zeros((g, d_max), dtype=np.float64)
+    anti_init = np.zeros((g, d_max), dtype=np.float64)
+    for i in range(n):
+        for p in snapshot.pods_by_node[i]:
+            for terms, groups, init in ((aff_terms, aff_group, aff_init),
+                                        (anti_terms, anti_group, anti_init)):
+                for t_idx, term in enumerate(terms):
+                    gi = groups[t_idx]
+                    d = node_domain[gi, i]
+                    if d < 0:
+                        continue
+                    if _term_matches_pod(term, owner_ns, p, ns_labels):
+                        init[gi, d] += 1.0
+
+    self_aff = np.asarray([_term_matches_pod(t, owner_ns, pod_self, ns_labels)
+                           for t in aff_terms] or [False], dtype=bool)
+    self_anti = np.asarray([_term_matches_pod(t, owner_ns, pod_self, ns_labels)
+                            for t in anti_terms] or [False], dtype=bool)
+    escape = all(_term_matches_pod(t, owner_ns, pod_self, ns_labels)
+                 for t in aff_terms) if aff_terms else False
+
+    # Existing pods' required anti-affinity vs the incoming pod → static
+    # per-node block mask (their terms never change during the simulation).
+    blocked_pairs = set()
+    for i in range(n):
+        for p in snapshot.pods_by_node[i]:
+            p_ns = (p.get("metadata") or {}).get("namespace") or "default"
+            for term in _required_terms(p, "podAntiAffinity"):
+                if _term_matches_pod(term, p_ns, pod, ns_labels):
+                    key = term.get("topologyKey", "")
+                    val = snapshot.node_labels(i).get(key)
+                    if val is not None:
+                        blocked_pairs.add((key, val))
+    existing_anti_static = np.zeros(n, dtype=bool)
+    if blocked_pairs:
+        for i in range(n):
+            labels = snapshot.node_labels(i)
+            existing_anti_static[i] = any(labels.get(k) == v
+                                          for k, v in blocked_pairs)
+
+    # Preferred terms: static contributions from existing pods (both
+    # directions), dynamic handled through carried per-term domain weights.
+    static_pref = np.zeros(n, dtype=np.float64)
+    pair_scores: Dict[Tuple[str, str], float] = {}
+
+    def add_pair(key: str, node_idx: int, weight: float):
+        val = snapshot.node_labels(node_idx).get(key)
+        if val is not None:
+            pair_scores[(key, val)] = pair_scores.get((key, val), 0.0) + weight
+
+    has_pref_constraints = bool(pref_terms)
+    for i in range(n):
+        for p in snapshot.pods_by_node[i]:
+            p_ns = (p.get("metadata") or {}).get("namespace") or "default"
+            p_has_affinity = bool((p.get("spec") or {}).get("affinity"))
+            # (a) incoming pod's preferred terms vs this existing pod.
+            if has_pref_constraints:
+                for term, w in pref_terms:
+                    if _term_matches_pod(term, owner_ns, p, ns_labels):
+                        add_pair(term.get("topologyKey", ""), i, w)
+            # (b) this existing pod's preferred terms vs the incoming pod.
+            # Processed when the pod has any affinity, or always when the
+            # incoming pod has preferred constraints (scoring.go:219-227).
+            if p_has_affinity or has_pref_constraints:
+                for t in _preferred_terms(p, "podAffinity"):
+                    term = t.get("podAffinityTerm") or {}
+                    if _term_matches_pod(term, p_ns, pod, ns_labels):
+                        add_pair(term.get("topologyKey", ""), i,
+                                 float(t.get("weight", 0)))
+                for t in _preferred_terms(p, "podAntiAffinity"):
+                    term = t.get("podAffinityTerm") or {}
+                    if _term_matches_pod(term, p_ns, pod, ns_labels):
+                        add_pair(term.get("topologyKey", ""), i,
+                                 -float(t.get("weight", 0)))
+    if pair_scores:
+        for i in range(n):
+            labels = snapshot.node_labels(i)
+            static_pref[i] = sum(w for (k, v), w in pair_scores.items()
+                                 if labels.get(k) == v)
+
+    self_pref = np.asarray([_term_matches_pod(t, owner_ns, pod_self, ns_labels)
+                            for t, _ in pref_terms] or [False], dtype=bool)
+
+    return AffinityEncoding(
+        num_aff_terms=len(aff_terms), num_anti_terms=len(anti_terms),
+        max_domains=d_max,
+        aff_group=aff_group if len(aff_terms) else np.zeros(1, np.int32),
+        anti_group=anti_group if len(anti_terms) else np.zeros(1, np.int32),
+        group_keys=keys, node_domain=node_domain,
+        aff_init=aff_init, anti_init=anti_init,
+        self_aff_match=self_aff, self_anti_match=self_anti,
+        escape_allowed=escape, existing_anti_static=existing_anti_static,
+        num_pref_terms=len(pref_terms),
+        pref_group=pref_group if pref_terms else np.zeros(1, np.int32),
+        pref_weight=np.asarray([w for _, w in pref_terms] or [0.0]),
+        self_pref_match=self_pref,
+        static_pref_score=static_pref,
+        has_any_score_terms=bool(pref_terms) or bool(pair_scores),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Device-side kernels
+# ---------------------------------------------------------------------------
+
+def filter_all(aff_counts: jnp.ndarray, anti_counts: jnp.ndarray,
+               node_domain: jnp.ndarray, aff_group: jnp.ndarray,
+               anti_group: jnp.ndarray, num_aff: int, num_anti: int,
+               escape_allowed: bool, existing_anti_static: jnp.ndarray,
+               existing_anti_dyn_fail: jnp.ndarray
+               ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Run the three probes for every node.
+
+    Returns (pass, fail_affinity, fail_anti, fail_existing_anti), each bool[N].
+    """
+    n = node_domain.shape[1]
+    dom = jnp.clip(node_domain, 0, aff_counts.shape[1] - 1).astype(jnp.int32)
+    has_key = node_domain >= 0                                  # [G, N]
+
+    if num_aff > 0:
+        g = aff_group                                           # [Ta]
+        term_dom = dom[g]                                       # [Ta, N]
+        term_has = has_key[g]
+        cnt = jnp.take_along_axis(aff_counts[g], term_dom, axis=1)
+        term_ok = term_has & (cnt > 0)
+        all_keys = jnp.all(term_has, axis=0)
+        pods_exist = jnp.all(term_ok, axis=0)
+        map_empty = jnp.sum(aff_counts) == 0
+        escape = all_keys & map_empty & bool(escape_allowed)
+        aff_ok = pods_exist | escape
+    else:
+        aff_ok = jnp.ones(n, dtype=bool)
+
+    if num_anti > 0:
+        g = anti_group
+        term_dom = dom[g]
+        term_has = has_key[g]
+        cnt = jnp.take_along_axis(anti_counts[g], term_dom, axis=1)
+        anti_fail = jnp.any(term_has & (cnt > 0), axis=0)
+    else:
+        anti_fail = jnp.zeros(n, dtype=bool)
+
+    eanti_fail = existing_anti_static | existing_anti_dyn_fail
+    fail_aff = ~aff_ok
+    fail_anti = aff_ok & anti_fail
+    fail_eanti = aff_ok & ~anti_fail & eanti_fail
+    ok = aff_ok & ~anti_fail & ~eanti_fail
+    return ok, fail_aff, fail_anti, fail_eanti
+
+
+def existing_anti_dynamic_fail(anti_counts_dyn: jnp.ndarray,
+                               node_domain: jnp.ndarray,
+                               anti_group: jnp.ndarray,
+                               num_anti: int) -> jnp.ndarray:
+    """satisfyExistingPodsAntiAffinity dynamic part: placed clones' required
+    anti-affinity terms.  Because clones share the incoming pod's terms, the
+    check reduces to the incoming-anti probe over the dynamic counts."""
+    n = node_domain.shape[1]
+    if num_anti == 0:
+        return jnp.zeros(n, dtype=bool)
+    dom = jnp.clip(node_domain, 0, anti_counts_dyn.shape[1] - 1).astype(jnp.int32)
+    has_key = node_domain >= 0
+    g = anti_group
+    cnt = jnp.take_along_axis(anti_counts_dyn[g], dom[g], axis=1)
+    return jnp.any(has_key[g] & (cnt > 0), axis=0)
+
+
+def placement_update(counts: jnp.ndarray, node_domain: jnp.ndarray,
+                     group: jnp.ndarray, self_match: jnp.ndarray,
+                     chosen: jnp.ndarray, weight=None) -> jnp.ndarray:
+    """Scatter-add the clone's term contributions at the chosen node's domains.
+
+    counts: f[G, D]; group: i32[T]; self_match: bool[T].  With `weight` given
+    (preferred terms), adds weight instead of 1 — the engine pre-doubles the
+    weight for the both-directions effect (scoring.go:121-127 + :154-160)."""
+    dom = node_domain[group, chosen]                            # [T]
+    amount = self_match.astype(counts.dtype) * (dom >= 0)
+    if weight is not None:
+        amount = amount * weight
+    return counts.at[group, jnp.clip(dom, 0, None)].add(amount)
+
+
+def pref_score(pref_counts: jnp.ndarray, node_domain: jnp.ndarray,
+               pref_group: jnp.ndarray, static_pref: jnp.ndarray,
+               num_pref: int) -> jnp.ndarray:
+    """Raw preferred-term score per node: static + carried dynamic weights."""
+    score = static_pref
+    if num_pref > 0:
+        dom = jnp.clip(node_domain, 0, pref_counts.shape[1] - 1).astype(jnp.int32)
+        has_key = node_domain >= 0
+        # Sum each group's row once (counts are merged per (key,value) pair,
+        # scoring.go topologyScore map) — not once per term.
+        g_rows = jnp.take_along_axis(pref_counts, dom, axis=1)   # [G, N]
+        score = score + jnp.sum(jnp.where(has_key, g_rows, 0.0), axis=0)
+    return score
+
+
+def normalize(raw: jnp.ndarray, feasible: jnp.ndarray,
+              active: bool) -> jnp.ndarray:
+    """NormalizeScore (scoring.go:268-300): min-max to 0-100 over the feasible
+    set; all-equal (or inactive plugin) → zeros."""
+    if not active:
+        return jnp.zeros_like(raw)
+    neg_inf = jnp.asarray(-jnp.inf, raw.dtype)
+    pos_inf = jnp.asarray(jnp.inf, raw.dtype)
+    max_s = jnp.max(jnp.where(feasible, raw, neg_inf))
+    min_s = jnp.min(jnp.where(feasible, raw, pos_inf))
+    diff = max_s - min_s
+    out = jnp.where(diff > 0, jnp.floor(100.0 * (raw - min_s) /
+                                        jnp.where(diff > 0, diff, 1.0)), 0.0)
+    return jnp.where(feasible, out, 0.0)

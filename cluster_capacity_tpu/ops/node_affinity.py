@@ -1,0 +1,42 @@
+"""NodeAffinity plugin: required match filter + preferred-term score precompute.
+
+Reference: /root/reference/vendor/k8s.io/kubernetes/pkg/scheduler/framework/plugins/nodeaffinity/node_affinity.go:
+- Filter (:147-215): spec.nodeSelector AND requiredDuringScheduling node
+  affinity must match; reason "node(s) didn't match Pod's node affinity/selector".
+- Score (:240-285): sum of weights of matching preferred terms; normalized with
+  DefaultNormalizeScore(reverse=false).  PreScore returns Skip when the pod has
+  no preferred terms (:246-249) — the plugin then contributes nothing.
+
+Static per node; normalize happens on device per scan step.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..models.labels import (pod_matches_node_selector_and_affinity,
+                             preferred_node_affinity_score)
+from ..models.snapshot import ClusterSnapshot
+
+REASON = "node(s) didn't match Pod's node affinity/selector"
+
+
+def static_mask(snapshot: ClusterSnapshot, pod: dict) -> np.ndarray:
+    spec = pod.get("spec") or {}
+    return np.asarray(
+        [pod_matches_node_selector_and_affinity(spec, snapshot.node_labels(i),
+                                                snapshot.node_names[i])
+         for i in range(snapshot.num_nodes)], dtype=bool)
+
+
+def has_preferred_terms(pod: dict) -> bool:
+    affinity = ((pod.get("spec") or {}).get("affinity") or {}).get("nodeAffinity") or {}
+    return bool(affinity.get("preferredDuringSchedulingIgnoredDuringExecution"))
+
+
+def static_raw_score(snapshot: ClusterSnapshot, pod: dict) -> np.ndarray:
+    spec = pod.get("spec") or {}
+    return np.asarray(
+        [preferred_node_affinity_score(spec, snapshot.node_labels(i),
+                                       snapshot.node_names[i])
+         for i in range(snapshot.num_nodes)], dtype=np.float64)

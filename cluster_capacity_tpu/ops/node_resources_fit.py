@@ -1,0 +1,133 @@
+"""NodeResourcesFit: filter + scoring strategies, as pure JAX kernels.
+
+Reference semantics:
+- Filter: /root/reference/vendor/k8s.io/kubernetes/pkg/scheduler/framework/plugins/noderesources/fit.go:564-660
+  (fitsRequest): always check pod-count slot; each resource checked only when the
+  pod requests it; insufficient reasons reported per resource.
+- LeastAllocated score: least_allocated.go:30-60
+  floor((cap-req)*100/cap) per resource, weighted integer mean.
+- MostAllocated score: most_allocated.go:30-65 (mirror, req clamped to cap).
+- RequestedToCapacityRatio: requested_to_capacity_ratio.go:60 +
+  helper.BuildBrokerFunction piecewise-linear shape.
+- cpu/mem requested side uses NonZeroRequested unless useRequested
+  (resource_allocation.go:85-140); scoring pod requests use 100m/200MB defaults
+  for missing cpu/mem.
+
+All functions operate on the whole node axis at once ([N]-shaped outputs) so
+they vmap over pod batches and shard over a device mesh on the node axis.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.snapshot import IDX_PODS
+
+MAX_NODE_SCORE = 100.0
+
+
+def _floor_div(num, den):
+    """Integer floor(num/den) computed in floats; exact when inputs are exact
+    integers in the dtype's range (float64 parity mode guarantees this)."""
+    return jnp.floor(num / jnp.maximum(den, 1e-30))
+
+
+class FitVerdict(NamedTuple):
+    mask: jnp.ndarray          # bool[N] — node passes the fit filter
+    insufficient: jnp.ndarray  # bool[N, R] — per-resource "Insufficient X"
+    too_many_pods: jnp.ndarray  # bool[N] — "Too many pods"
+
+
+def fit_filter(allocatable: jnp.ndarray, requested: jnp.ndarray,
+               req_vec: jnp.ndarray) -> FitVerdict:
+    """fitsRequest over all nodes.
+
+    allocatable, requested: [N, R]; req_vec: [R] with req_vec[IDX_PODS] ignored
+    (pod-count check is always `pods_on_node + 1 > allowed`).
+    """
+    too_many = requested[:, IDX_PODS] + 1.0 > allocatable[:, IDX_PODS]
+    free = allocatable - requested
+    pos = req_vec > 0
+    insufficient = (req_vec[None, :] > free) & pos[None, :]
+    insufficient = insufficient.at[:, IDX_PODS].set(False)
+    mask = ~(too_many | jnp.any(insufficient, axis=1))
+    return FitVerdict(mask=mask, insufficient=insufficient, too_many_pods=too_many)
+
+
+def least_allocated_score(alloc: jnp.ndarray, req_with_pod: jnp.ndarray,
+                          weights: jnp.ndarray) -> jnp.ndarray:
+    """leastResourceScorer over [N, K] strategy-resource views.
+
+    alloc, req_with_pod: [N, K]; weights: [K].  Resources with alloc==0 are
+    skipped (dropped from the weighted mean for that node)."""
+    valid = alloc > 0
+    over = req_with_pod > alloc
+    per_res = jnp.where(over, 0.0, _floor_div((alloc - req_with_pod) * MAX_NODE_SCORE,
+                                              alloc))
+    per_res = jnp.where(valid, per_res, 0.0)
+    wsum = jnp.sum(jnp.where(valid, weights[None, :], 0.0), axis=1)
+    total = jnp.sum(per_res * weights[None, :], axis=1)
+    return jnp.where(wsum > 0, _floor_div(total, wsum), 0.0)
+
+
+def most_allocated_score(alloc: jnp.ndarray, req_with_pod: jnp.ndarray,
+                         weights: jnp.ndarray) -> jnp.ndarray:
+    """mostResourceScorer: requested clamped to capacity."""
+    valid = alloc > 0
+    req = jnp.minimum(req_with_pod, alloc)
+    per_res = jnp.where(valid, _floor_div(req * MAX_NODE_SCORE, alloc), 0.0)
+    wsum = jnp.sum(jnp.where(valid, weights[None, :], 0.0), axis=1)
+    total = jnp.sum(per_res * weights[None, :], axis=1)
+    return jnp.where(wsum > 0, _floor_div(total, wsum), 0.0)
+
+
+def requested_to_capacity_ratio_score(alloc: jnp.ndarray,
+                                      req_with_pod: jnp.ndarray,
+                                      weights: jnp.ndarray,
+                                      shape_utilization: Sequence[float],
+                                      shape_score: Sequence[float]) -> jnp.ndarray:
+    """requestedToCapacityRatioScorer: per-resource utilization (0-100) mapped
+    through the configured piecewise-linear shape (scores 0-10, scaled x10),
+    then the same weighted integer mean.
+
+    Mirrors helper.BuildBrokerFunction semantics: utilization below the first
+    point gets the first score, above the last point the last score; between
+    points linear interpolation truncated toward zero per segment."""
+    xs = jnp.asarray(np.asarray(shape_utilization, dtype=np.float64),
+                     dtype=alloc.dtype)
+    ys = jnp.asarray(np.asarray(shape_score, dtype=np.float64) * 10.0,
+                     dtype=alloc.dtype)
+    valid = alloc > 0
+    util = jnp.where(valid, _floor_div(req_with_pod * MAX_NODE_SCORE, alloc), 0.0)
+    per_res = jnp.trunc(jnp.interp(util, xs, ys))
+    per_res = jnp.where(valid, per_res, 0.0)
+    wsum = jnp.sum(jnp.where(valid, weights[None, :], 0.0), axis=1)
+    total = jnp.sum(per_res * weights[None, :], axis=1)
+    return jnp.where(wsum > 0, _floor_div(total, wsum), 0.0)
+
+
+def balanced_allocation_score(alloc: jnp.ndarray,
+                              req_with_pod: jnp.ndarray) -> jnp.ndarray:
+    """NodeResourcesBalancedAllocation (balanced_allocation.go:146-182).
+
+    alloc/req_with_pod: [N, K] over the plugin's resource list (default
+    cpu+memory), using actual Requested (useRequested=true).  fraction clamped
+    to 1; K==2 → std=|f0-f1|/2; K>2 → population std; score trunc((1-std)*100).
+    Resources with alloc==0 are skipped, changing the effective count per node.
+    """
+    valid = alloc > 0
+    frac = jnp.where(valid, jnp.minimum(req_with_pod / jnp.maximum(alloc, 1e-30),
+                                        1.0), 0.0)
+    count = jnp.sum(valid, axis=1)
+    mean = jnp.sum(frac, axis=1) / jnp.maximum(count, 1)
+    var = jnp.sum(jnp.where(valid, (frac - mean[:, None]) ** 2, 0.0), axis=1) \
+        / jnp.maximum(count, 1)
+    std_general = jnp.sqrt(var)
+    # Exactly-two-resources fast path used by upstream: |f0 - f1| / 2 computed
+    # over the two valid entries.  With K==2 and both valid the general formula
+    # equals it analytically; when exactly one resource is valid std=0.
+    std = jnp.where(count >= 2, std_general, 0.0)
+    return jnp.trunc((1.0 - std) * MAX_NODE_SCORE)

@@ -1,0 +1,271 @@
+"""PodTopologySpread: filter + score as carried domain-count tensors.
+
+Reference semantics (/root/reference/vendor/k8s.io/kubernetes/pkg/scheduler/framework/plugins/podtopologyspread/):
+- PreFilter (filtering.go:234-308): per hard constraint, count same-namespace
+  pods matching the constraint selector per topology domain; nodes are counted
+  only if they carry ALL hard topology keys and pass per-constraint node
+  inclusion policies (NodeAffinityPolicy=Honor, NodeTaintsPolicy=Ignore by
+  default, common.go:42-56).
+- Filter (filtering.go:310-357): reject when
+  matchNum + selfMatch - minMatchNum > maxSkew; missing topology key is
+  UnschedulableAndUnresolvable.  minMatchNum treats the global minimum as 0
+  when the eligible-domain count is below minDomains (filtering.go:56-69).
+- Score (scoring.go:100-260): per soft constraint, score = cnt*log(size+2) +
+  (maxSkew-1), hostname constraints count pods on the node itself; normalized
+  as 100*(max+min-s)/max over the feasible set with ignored nodes zeroed.
+
+TPU design: domains are integer-encoded per constraint on the host; the scan
+carries `counts[C, D]` tensors updated by a one-hot scatter at each placement.
+Because every clone is identical, whether a placement increments a constraint's
+domain count is a static boolean (`self_match`) times the static per-node
+counting eligibility.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Mapping, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.labels import (find_matching_untolerated_taint,
+                             match_label_selector,
+                             pod_matches_node_selector_and_affinity)
+from ..models.podspec import pod_tolerations
+from ..models.snapshot import ClusterSnapshot
+
+REASON_CONSTRAINTS = "node(s) didn't match pod topology spread constraints"
+REASON_MISSING_LABEL = ("node(s) didn't match pod topology spread constraints "
+                        "(missing required label)")
+LABEL_HOSTNAME = "kubernetes.io/hostname"
+
+_BIG = np.float64(2**31 - 1)  # stand-in for the MaxInt32 critical-path init
+
+
+@dataclass
+class SpreadConstraintSet:
+    """Encoded constraints of one kind (hard or soft) for one template."""
+
+    num_constraints: int
+    max_domains: int
+    topology_keys: List[str]
+    max_skew: np.ndarray          # f64[C]
+    min_domains: np.ndarray       # f64[C] (hard only; 1 when unset)
+    is_hostname: np.ndarray       # bool[C]
+    self_match: np.ndarray        # bool[C] — template matches its own selector
+    node_domain: np.ndarray       # i32[C, N], -1 when node lacks the key
+    node_countable: np.ndarray    # bool[C, N] — inclusion-policy eligibility
+    node_has_all_keys: np.ndarray  # bool[N] — node carries every key in set
+    domain_valid: np.ndarray      # bool[C, D] — domain exists among countable nodes
+    init_counts: np.ndarray       # f64[C, D] — existing matching pods per domain
+    node_existing: np.ndarray     # f64[C, N] — matching pods on the node itself
+
+    @property
+    def empty(self) -> bool:
+        return self.num_constraints == 0
+
+
+def _constraints_of(pod: Mapping, action: str) -> List[dict]:
+    out = []
+    for c in (pod.get("spec") or {}).get("topologySpreadConstraints") or []:
+        if (c.get("whenUnsatisfiable") or "DoNotSchedule") == action:
+            out.append(c)
+    return out
+
+
+def _count_matching(pods: Sequence[Mapping], selector, namespace: str) -> int:
+    """countPodsMatchSelector: same-namespace, selector match, skip terminating."""
+    n = 0
+    for p in pods:
+        meta = p.get("metadata") or {}
+        if (meta.get("namespace") or "default") != namespace:
+            continue
+        if meta.get("deletionTimestamp"):
+            continue
+        if match_label_selector(selector, meta.get("labels") or {}):
+            n += 1
+    return n
+
+
+def encode_constraints(snapshot: ClusterSnapshot, pod: Mapping,
+                       action: str) -> SpreadConstraintSet:
+    """Encode the pod's constraints with whenUnsatisfiable==action."""
+    constraints = _constraints_of(pod, action)
+    return _encode(snapshot, pod, constraints)
+
+
+def _encode(snapshot: ClusterSnapshot, pod: Mapping,
+            constraints: List[dict]) -> SpreadConstraintSet:
+    n = snapshot.num_nodes
+    c_num = len(constraints)
+    namespace = (pod.get("metadata") or {}).get("namespace") or "default"
+    pod_labels = (pod.get("metadata") or {}).get("labels") or {}
+    spec = pod.get("spec") or {}
+    tols = pod_tolerations(pod)
+
+    keys = [c.get("topologyKey", "") for c in constraints]
+    has_all = np.ones(n, dtype=bool)
+    for i in range(n):
+        labels = snapshot.node_labels(i)
+        has_all[i] = all(k in labels for k in keys)
+
+    # Domain vocabularies per constraint.
+    domains: List[dict] = []
+    node_domain = np.full((max(c_num, 1), n), -1, dtype=np.int32)
+    countable = np.zeros((max(c_num, 1), n), dtype=bool)
+    for ci, c in enumerate(constraints):
+        vocab: dict = {}
+        for i in range(n):
+            labels = snapshot.node_labels(i)
+            val = labels.get(keys[ci])
+            if val is None:
+                continue
+            if val not in vocab:
+                vocab[val] = len(vocab)
+            node_domain[ci, i] = vocab[val]
+        domains.append(vocab)
+        affinity_policy = c.get("nodeAffinityPolicy") or "Honor"
+        taints_policy = c.get("nodeTaintsPolicy") or "Ignore"
+        for i in range(n):
+            if not has_all[i]:
+                continue
+            ok = True
+            if affinity_policy == "Honor":
+                ok = pod_matches_node_selector_and_affinity(
+                    spec, snapshot.node_labels(i), snapshot.node_names[i])
+            if ok and taints_policy == "Honor":
+                ok = find_matching_untolerated_taint(
+                    snapshot.node_taints(i), tols,
+                    ("NoSchedule", "NoExecute")) is None
+            countable[ci, i] = ok
+
+    d_max = max([len(v) for v in domains], default=0)
+    d_max = max(d_max, 1)
+    init_counts = np.zeros((max(c_num, 1), d_max), dtype=np.float64)
+    node_existing = np.zeros((max(c_num, 1), n), dtype=np.float64)
+    domain_valid = np.zeros((max(c_num, 1), d_max), dtype=bool)
+    self_match = np.zeros(max(c_num, 1), dtype=bool)
+    for ci, c in enumerate(constraints):
+        sel = c.get("labelSelector")
+        self_match[ci] = match_label_selector(sel, pod_labels)
+        for i in range(n):
+            cnt = _count_matching(snapshot.pods_by_node[i], sel, namespace)
+            node_existing[ci, i] = cnt
+            if countable[ci, i]:
+                d = node_domain[ci, i]
+                domain_valid[ci, d] = True
+                init_counts[ci, d] += cnt
+
+    return SpreadConstraintSet(
+        num_constraints=c_num,
+        max_domains=d_max,
+        topology_keys=keys,
+        max_skew=np.asarray([float(c.get("maxSkew", 1)) for c in constraints] or [1.0]),
+        min_domains=np.asarray([float(c.get("minDomains") or 1)
+                                for c in constraints] or [1.0]),
+        is_hostname=np.asarray([k == LABEL_HOSTNAME for k in keys] or [False]),
+        self_match=self_match,
+        node_domain=node_domain,
+        node_countable=countable,
+        node_has_all_keys=has_all,
+        domain_valid=domain_valid,
+        init_counts=init_counts,
+        node_existing=node_existing,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Device-side kernels (pure JAX; operate on the carried counts tensor)
+# ---------------------------------------------------------------------------
+
+def hard_filter(counts: jnp.ndarray, node_domain: jnp.ndarray,
+                domain_valid: jnp.ndarray, max_skew: jnp.ndarray,
+                min_domains: jnp.ndarray, self_match: jnp.ndarray
+                ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Filter over all nodes.  Returns (pass[N], missing_label[N]).
+
+    counts: f[C, D]; node_domain: i32[C, N]; domain_valid: bool[C, D].
+    """
+    has_key = node_domain >= 0                               # [C, N]
+    missing = ~jnp.all(has_key, axis=0)                      # [N]
+    # minMatchNum per constraint: min over valid domains; MaxInt when none;
+    # forced to 0 when eligible-domain count < minDomains.
+    masked = jnp.where(domain_valid, counts, _BIG)
+    min_match = jnp.min(masked, axis=1)                      # [C]
+    domains_num = jnp.sum(domain_valid, axis=1)
+    min_match = jnp.where(domains_num < min_domains, 0.0, min_match)
+
+    dom = jnp.clip(node_domain, 0, counts.shape[1] - 1).astype(jnp.int32)
+    match_num = jnp.take_along_axis(counts, dom, axis=1)     # [C, N]
+    skew = match_num + self_match[:, None] - min_match[:, None]   # [C, N]
+    violated = jnp.any((skew > max_skew[:, None]) & has_key, axis=0)
+    return ~(missing | violated), missing
+
+
+def placement_update(counts: jnp.ndarray, node_domain: jnp.ndarray,
+                     node_countable: jnp.ndarray, self_match: jnp.ndarray,
+                     chosen: jnp.ndarray) -> jnp.ndarray:
+    """AddPod (PreFilterExtensions) equivalent: bump the chosen node's domain
+    count for every constraint whose selector matches the clone."""
+    dom = node_domain[:, chosen]                             # [C]
+    inc = (self_match & node_countable[:, chosen] & (dom >= 0)).astype(counts.dtype)
+    one_hot = jnp.zeros_like(counts).at[
+        jnp.arange(counts.shape[0]), jnp.clip(dom, 0, None)].set(inc)
+    return counts + one_hot
+
+
+def soft_score(counts: jnp.ndarray, node_existing_dyn: jnp.ndarray,
+               node_domain: jnp.ndarray, is_hostname: jnp.ndarray,
+               max_skew: jnp.ndarray, ignored: jnp.ndarray,
+               feasible: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Raw spread score for soft constraints over the current feasible set.
+
+    counts: f[C, D] current domain counts (soft constraints);
+    node_existing_dyn: f[C, N] per-node matching-pod counts (for hostname);
+    ignored: bool[N] nodes missing required soft topology labels.
+    Returns (raw_score[N], scored[N]) where scored nodes are feasible & ~ignored.
+    """
+    scorable = feasible & ~ignored
+    has_key = node_domain >= 0                               # [C, N]
+    dom = jnp.clip(node_domain, 0, counts.shape[1] - 1).astype(jnp.int32)
+
+    # Domain "present" = some scorable node carries it → defines topology size.
+    c_idx = jnp.arange(counts.shape[0])[:, None]
+    present = jnp.zeros(counts.shape, dtype=bool).at[
+        jnp.broadcast_to(c_idx, dom.shape), dom].max(
+            scorable[None, :] & has_key)
+    topo_size = jnp.sum(present, axis=1)                     # [C]
+    host_size = jnp.sum(scorable)
+    size = jnp.where(is_hostname, host_size, topo_size)
+    tp_weight = jnp.log(size.astype(counts.dtype) + 2.0)     # [C]
+
+    domain_cnt = jnp.take_along_axis(counts, dom, axis=1)    # [C, N]
+    cnt = jnp.where(is_hostname[:, None], node_existing_dyn, domain_cnt)
+    per_c = jnp.where(has_key, cnt * tp_weight[:, None] + (max_skew[:, None] - 1.0),
+                      0.0)
+    raw = jnp.round(jnp.sum(per_c, axis=0))
+    return raw, scorable
+
+
+def soft_normalize(raw: jnp.ndarray, scored: jnp.ndarray) -> jnp.ndarray:
+    """NormalizeScore (scoring.go:226-265): 100*(max+min-s)/max over scored
+    nodes; ignored/unscored nodes get 0; max==0 → 100."""
+    neg_inf = jnp.asarray(-jnp.inf, raw.dtype)
+    pos_inf = jnp.asarray(jnp.inf, raw.dtype)
+    any_scored = jnp.any(scored)
+    max_s = jnp.max(jnp.where(scored, raw, neg_inf))
+    min_s = jnp.min(jnp.where(scored, raw, pos_inf))
+    max_s = jnp.where(any_scored, max_s, 0.0)
+    min_s = jnp.where(any_scored, min_s, 0.0)
+    out = jnp.where(max_s == 0, 100.0,
+                    jnp.floor(100.0 * (max_s + min_s - raw) / jnp.maximum(max_s, 1e-30)))
+    return jnp.where(scored, out, 0.0)
+
+
+def static_ignored(spread: SpreadConstraintSet, require_all: bool) -> np.ndarray:
+    """Nodes the score pass ignores (missing soft topology labels when
+    requireAllTopologies)."""
+    if spread.empty or not require_all:
+        return np.zeros(spread.node_has_all_keys.shape[0], dtype=bool)
+    return ~spread.node_has_all_keys

@@ -1,0 +1,157 @@
+"""Scheduler profile configuration.
+
+Mirrors the three config tiers of the reference
+(/root/reference/cmd/cluster-capacity/app/server.go:102-163 + pkg/utils/utils.go:90-143):
+CLI flags, a pod-spec file, and a KubeSchedulerConfiguration-style profile that
+controls which filter/score kernels run and their weights.  Defaults mirror
+vendor/.../scheduler/apis/config/v1/default_plugins.go:30-51.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Dict, List, Optional, Tuple
+
+import yaml
+
+# Default MultiPoint score weights (default_plugins.go:34-51).
+DEFAULT_SCORE_WEIGHTS = {
+    "TaintToleration": 3,
+    "NodeAffinity": 2,
+    "NodeResourcesFit": 1,
+    "PodTopologySpread": 2,
+    "InterPodAffinity": 2,
+    "NodeResourcesBalancedAllocation": 1,
+    "ImageLocality": 1,
+}
+
+DEFAULT_FILTERS = [
+    "NodeUnschedulable",
+    "NodeName",
+    "TaintToleration",
+    "NodeAffinity",
+    "NodePorts",
+    "NodeResourcesFit",
+    "PodTopologySpread",
+    "InterPodAffinity",
+]
+
+ALL_SCORE_PLUGINS = list(DEFAULT_SCORE_WEIGHTS)
+
+
+@dataclass
+class ScoringStrategy:
+    """NodeResourcesFitArgs.ScoringStrategy (apis/config defaults: LeastAllocated
+    over cpu:1, memory:1)."""
+
+    type: str = "LeastAllocated"
+    resources: List[Tuple[str, int]] = field(
+        default_factory=lambda: [("cpu", 1), ("memory", 1)])
+    # RequestedToCapacityRatio shape (utilization → score 0-10).
+    shape_utilization: List[float] = field(default_factory=lambda: [0.0, 100.0])
+    shape_score: List[float] = field(default_factory=lambda: [0.0, 10.0])
+
+
+@dataclass
+class SchedulerProfile:
+    name: str = "default-scheduler"
+    filters: List[str] = field(default_factory=lambda: list(DEFAULT_FILTERS))
+    score_weights: Dict[str, int] = field(
+        default_factory=lambda: dict(DEFAULT_SCORE_WEIGHTS))
+    fit_strategy: ScoringStrategy = field(default_factory=ScoringStrategy)
+    balanced_resources: List[Tuple[str, int]] = field(
+        default_factory=lambda: [("cpu", 1), ("memory", 1)])
+    # Parity mode: score every feasible node (reference's adaptive sampling,
+    # schedule_one.go:697-725, is order-dependent; disabled for determinism).
+    percentage_of_nodes_to_score: int = 100
+    # Deterministic tie-break (lowest node index) instead of the reference's
+    # reservoir sampling among score ties (schedule_one.go:894-946).
+    deterministic: bool = True
+    seed: int = 0
+    # float64 gives bit-exact parity with the reference's int64 score
+    # arithmetic (CPU tests); float32 is the TPU fast path.
+    compute_dtype: str = "float32"
+
+    def filter_enabled(self, name: str) -> bool:
+        return name in self.filters
+
+    def score_weight(self, name: str) -> int:
+        return int(self.score_weights.get(name, 0))
+
+    @classmethod
+    def parity(cls) -> "SchedulerProfile":
+        return cls(compute_dtype="float64")
+
+
+def load_scheduler_config(path: str) -> SchedulerProfile:
+    """Load a KubeSchedulerConfiguration YAML (the --default-config /
+    --config input format, cmd/cluster-capacity/app/server.go:193-208).
+
+    Supports: profiles[0].plugins.{filter,score}.{enabled,disabled} (with "*"
+    wildcard) and pluginConfig args for NodeResourcesFitArgs scoringStrategy.
+    Unknown plugins are preserved by name but have no kernel; enabling one that
+    has no implementation raises.
+    """
+    with open(path) as f:
+        cfg = yaml.safe_load(f) or {}
+    prof = SchedulerProfile()
+    profiles = cfg.get("profiles") or []
+    if not profiles:
+        return prof
+    p0 = profiles[0] or {}
+    # Profile 0 is forcibly renamed default-scheduler (pkg/utils/utils.go:102-108).
+    prof.name = "default-scheduler"
+    plugins = p0.get("plugins") or {}
+
+    def apply(section: str, defaults: List[str]) -> List[str]:
+        sec = plugins.get(section) or {}
+        out = list(defaults)
+        for d in sec.get("disabled") or []:
+            name = d.get("name")
+            if name == "*":
+                out = []
+            elif name in out:
+                out.remove(name)
+        for e in sec.get("enabled") or []:
+            name = e.get("name")
+            if name and name not in out:
+                out.append(name)
+        return out
+
+    prof.filters = apply("filter", DEFAULT_FILTERS)
+    score_names = apply("score", list(DEFAULT_SCORE_WEIGHTS))
+    weights = {}
+    for name in score_names:
+        weights[name] = DEFAULT_SCORE_WEIGHTS.get(name, 1)
+    sec = plugins.get("score") or {}
+    for e in sec.get("enabled") or []:
+        if e.get("weight") and e.get("name") in weights:
+            weights[e["name"]] = int(e["weight"])
+    prof.score_weights = weights
+
+    for pc in p0.get("pluginConfig") or []:
+        if pc.get("name") == "NodeResourcesFit":
+            args = pc.get("args") or {}
+            strat = args.get("scoringStrategy") or {}
+            if strat:
+                resources = [(r.get("name"), int(r.get("weight", 1)))
+                             for r in strat.get("resources") or []]
+                shape = strat.get("requestedToCapacityRatio", {}).get("shape") or []
+                prof.fit_strategy = ScoringStrategy(
+                    type=strat.get("type", "LeastAllocated"),
+                    resources=resources or [("cpu", 1), ("memory", 1)],
+                    shape_utilization=[float(s.get("utilization", 0))
+                                       for s in shape] or [0.0, 100.0],
+                    shape_score=[float(s.get("score", 0)) for s in shape]
+                    or [0.0, 10.0],
+                )
+        if pc.get("name") == "NodeResourcesBalancedAllocation":
+            args = pc.get("args") or {}
+            res = [(r.get("name"), int(r.get("weight", 1)))
+                   for r in args.get("resources") or []]
+            if res:
+                prof.balanced_resources = res
+    pct = p0.get("percentageOfNodesToScore") or cfg.get("percentageOfNodesToScore")
+    if pct:
+        prof.percentage_of_nodes_to_score = int(pct)
+    return prof

@@ -1,0 +1,67 @@
+"""Cluster snapshot file IO.
+
+The reference snapshots a live cluster over HTTPS (SyncWithClient,
+/root/reference/pkg/framework/simulator.go:176-295).  The TPU build adds an
+explicit on-disk snapshot format so capacity analysis is reproducible and
+offline (SURVEY.md §5 "Checkpoint / resume": snapshot save/load is a new
+capability).  Two formats are accepted:
+
+1. a mapping of object lists:
+   {"nodes": [...], "pods": [...], "services": [...], ...}
+2. a Kubernetes v1.List: {"kind": "List", "items": [objects with kind:]}
+
+Object-list keys mirror the ten resource kinds SyncWithClient copies.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List
+
+import yaml
+
+_KIND_TO_KEY = {
+    "Node": "nodes",
+    "Pod": "pods",
+    "Service": "services",
+    "PersistentVolumeClaim": "pvcs",
+    "PodDisruptionBudget": "pdbs",
+    "ReplicationController": "replication_controllers",
+    "ReplicaSet": "replica_sets",
+    "StatefulSet": "stateful_sets",
+    "StorageClass": "storage_classes",
+    "Namespace": "namespaces",
+    "LimitRange": "limit_ranges",
+}
+
+SNAPSHOT_KEYS = list(_KIND_TO_KEY.values())
+
+
+def load_snapshot_objects(path: str) -> Dict[str, List[dict]]:
+    with open(path) as f:
+        text = f.read()
+    data = json.loads(text) if text.lstrip().startswith("{") \
+        else yaml.safe_load(text)
+    if not isinstance(data, dict):
+        raise ValueError(f"snapshot file {path!r} did not parse to an object")
+    return parse_snapshot_dict(data)
+
+
+def parse_snapshot_dict(data: dict) -> Dict[str, List[dict]]:
+    out: Dict[str, List[dict]] = {}
+    if data.get("kind") == "List" or "items" in data and "nodes" not in data:
+        for obj in data.get("items") or []:
+            key = _KIND_TO_KEY.get(obj.get("kind", ""))
+            if key:
+                out.setdefault(key, []).append(obj)
+        return out
+    for key in SNAPSHOT_KEYS:
+        if key in data:
+            out[key] = list(data[key] or [])
+    return out
+
+
+def save_snapshot_objects(path: str, objects: Dict[str, List[dict]]) -> None:
+    with open(path, "w") as f:
+        yaml.safe_dump({k: v for k, v in objects.items() if v}, f,
+                       sort_keys=False)

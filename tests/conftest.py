@@ -1,0 +1,15 @@
+"""Test harness setup: force an 8-device virtual CPU mesh before JAX loads,
+and enable x64 so float arithmetic reproduces the reference's int64 score
+math bit-exactly (the parity protocol in BASELINE.md)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags +
+                               " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)

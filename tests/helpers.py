@@ -1,0 +1,72 @@
+"""Shared fixture builders mirroring the reference's test utilities:
+BuildTestNode / BuildTestPod (/root/reference/test/benchmark/pod_colocation_test.go:193-262)
+and setupNodes (/root/reference/pkg/framework/simulator_test.go:39-152)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def build_test_node(name: str, milli_cpu: int, mem: int, pods: int,
+                    labels: Optional[dict] = None, taints=None,
+                    unschedulable: bool = False, extra_alloc=None) -> dict:
+    alloc = {"cpu": f"{milli_cpu}m", "memory": str(mem), "pods": str(pods)}
+    if extra_alloc:
+        alloc.update(extra_alloc)
+    node = {
+        "metadata": {"name": name, "labels": dict(labels or {})},
+        "spec": {},
+        "status": {"allocatable": alloc, "capacity": dict(alloc)},
+    }
+    if taints:
+        node["spec"]["taints"] = taints
+    if unschedulable:
+        node["spec"]["unschedulable"] = True
+    return node
+
+
+def build_test_pod(name: str, milli_cpu: int = -1, mem: int = -1,
+                   node_name: str = "", labels: Optional[dict] = None,
+                   namespace: str = "default") -> dict:
+    requests = {}
+    if milli_cpu >= 0:
+        requests["cpu"] = f"{milli_cpu}m"
+    if mem >= 0:
+        requests["memory"] = str(mem)
+    return {
+        "metadata": {"name": name, "namespace": namespace,
+                     "labels": dict(labels or {})},
+        "spec": {
+            "containers": [{"name": "c0", "image": "img",
+                            "resources": {"requests": requests}}],
+            "nodeName": node_name,
+        },
+    }
+
+
+def setup_prediction_nodes():
+    """setupNodes (simulator_test.go:103-152): three nodes with differing
+    allocatable."""
+    return [
+        build_test_node("test-node-1", 300, int(1e9), 3),
+        build_test_node("test-node-2", 400, int(2e9), 3),
+        build_test_node("test-node-3", 1200, int(1e9), 3),
+    ]
+
+
+def prediction_pod():
+    """simulated-pod (simulator_test.go:179-215): 100m CPU / 5e6 memory."""
+    return {
+        "metadata": {"name": "simulated-pod", "namespace": "test-node-3"},
+        "spec": {
+            "restartPolicy": "Always",
+            "dnsPolicy": "ClusterFirst",
+            "containers": [{
+                "name": "c0",
+                "resources": {
+                    "requests": {"cpu": "100m", "memory": "5000000"},
+                    "limits": {"cpu": "100m", "memory": "5000000"},
+                },
+            }],
+        },
+    }

@@ -1,0 +1,104 @@
+"""Behavioral parity tests mirroring the reference's benchmark suite
+(/root/reference/test/benchmark/pod_colocation_test.go): pods with required
+self-affinity colocate on one node / one topology zone."""
+
+from cluster_capacity_tpu import ClusterCapacity, SchedulerProfile
+from cluster_capacity_tpu.models.podspec import default_pod
+
+from helpers import build_test_node, build_test_pod
+
+
+def _affinity_pod(topology_key: str):
+    pod = build_test_pod("pod-affinity", 10, 10, labels={"key": "value"})
+    pod["spec"]["affinity"] = {
+        "podAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": [{
+                "topologyKey": topology_key,
+                "labelSelector": {"matchLabels": {"key": "value"}},
+            }],
+        },
+    }
+    return pod
+
+
+def test_pod_affinity_hard_constraint_single_node():
+    nodes = [build_test_node(f"node{i}", 1000, 1000, 30,
+                             labels={"kubernetes.io/hostname": f"node{i}"})
+             for i in (1, 2, 3)]
+    cc = ClusterCapacity(default_pod(_affinity_pod("kubernetes.io/hostname")),
+                         max_limit=100, profile=SchedulerProfile.parity())
+    cc.sync_with_objects(nodes, namespaces=[{"metadata": {"name": "default"}}])
+    res = cc.run()
+    assert res.placed_count > 0
+    assert len(res.per_node_counts) == 1, \
+        f"expected colocation on one node, got {res.per_node_counts}"
+
+
+def test_pod_affinity_hard_constraint_many_nodes():
+    zone_key = "topology.domain/zone"
+    nodes = []
+    for zone in (1, 2, 3):
+        for i in (1, 2, 3):
+            nodes.append(build_test_node(
+                f"node{zone}-{i}", 1000, 1000, 30,
+                labels={zone_key: f"zone{zone}",
+                        "kubernetes.io/hostname": f"node{zone}-{i}"}))
+    cc = ClusterCapacity(default_pod(_affinity_pod(zone_key)),
+                         max_limit=100, profile=SchedulerProfile.parity())
+    cc.sync_with_objects(nodes, namespaces=[{"metadata": {"name": "default"}}])
+    res = cc.run()
+    assert res.placed_count > 0
+    zones = set()
+    for name in res.per_node_counts:
+        for node in nodes:
+            if node["metadata"]["name"] == name:
+                zones.add(node["metadata"]["labels"][zone_key])
+    assert len(zones) == 1, f"expected one zone, got {zones}"
+
+
+def test_pod_anti_affinity_one_per_node():
+    """Self anti-affinity on hostname → exactly one pod per node."""
+    nodes = [build_test_node(f"node{i}", 1000, 1000, 30,
+                             labels={"kubernetes.io/hostname": f"node{i}"})
+             for i in (1, 2, 3)]
+    pod = build_test_pod("pod-anti", 10, 10, labels={"key": "value"})
+    pod["spec"]["affinity"] = {
+        "podAntiAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": [{
+                "topologyKey": "kubernetes.io/hostname",
+                "labelSelector": {"matchLabels": {"key": "value"}},
+            }],
+        },
+    }
+    cc = ClusterCapacity(default_pod(pod), profile=SchedulerProfile.parity())
+    cc.sync_with_objects(nodes, namespaces=[{"metadata": {"name": "default"}}])
+    res = cc.run()
+    assert res.placed_count == 3
+    assert all(v == 1 for v in res.per_node_counts.values())
+    assert res.fail_counts.get(
+        "node(s) didn't match pod anti-affinity rules") == 3
+
+
+def test_existing_pod_anti_affinity_blocks():
+    """An existing pod whose required anti-affinity matches the incoming pod
+    blocks its topology domain."""
+    nodes = [build_test_node(f"node{i}", 1000, 1000, 30,
+                             labels={"kubernetes.io/hostname": f"node{i}"})
+             for i in (1, 2)]
+    blocker = build_test_pod("blocker", 10, 10, node_name="node1",
+                             labels={"team": "a"})
+    blocker["spec"]["affinity"] = {
+        "podAntiAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": [{
+                "topologyKey": "kubernetes.io/hostname",
+                "labelSelector": {"matchLabels": {"app": "web"}},
+            }],
+        },
+    }
+    pod = build_test_pod("incoming", 10, 10, labels={"app": "web"})
+    cc = ClusterCapacity(default_pod(pod), profile=SchedulerProfile.parity())
+    cc.sync_with_objects(nodes, [blocker],
+                         namespaces=[{"metadata": {"name": "default"}}])
+    res = cc.run()
+    assert "node1" not in res.per_node_counts
+    assert res.per_node_counts.get("node2", 0) > 0

@@ -1,0 +1,159 @@
+"""Filter-kernel parity tests: NodeName, NodeUnschedulable, TaintToleration,
+NodeAffinity, NodePorts, PodTopologySpread (reference semantics cited in each
+ops/ module)."""
+
+from cluster_capacity_tpu import ClusterCapacity, SchedulerProfile
+from cluster_capacity_tpu.models.podspec import default_pod
+
+from helpers import build_test_node, build_test_pod
+
+
+def _run(pod, nodes, existing=(), limit=0, **extra):
+    cc = ClusterCapacity(default_pod(pod), max_limit=limit,
+                         profile=SchedulerProfile.parity())
+    cc.sync_with_objects(nodes, existing, **extra)
+    return cc.run()
+
+
+def test_node_name_filter():
+    nodes = [build_test_node(f"n{i}", 1000, int(1e9), 10) for i in (1, 2, 3)]
+    pod = build_test_pod("pinned", 100, 0)
+    pod["spec"]["nodeName"] = "n2"
+    res = _run(pod, nodes)
+    assert set(res.per_node_counts) == {"n2"}
+    assert res.fail_counts.get(
+        "node(s) didn't match the requested node name") == 2
+
+
+def test_node_unschedulable():
+    nodes = [build_test_node("n1", 1000, int(1e9), 10),
+             build_test_node("n2", 1000, int(1e9), 10, unschedulable=True)]
+    res = _run(build_test_pod("p", 100, 0), nodes)
+    assert set(res.per_node_counts) == {"n1"}
+    assert res.fail_counts.get("node(s) were unschedulable") == 1
+
+
+def test_taint_toleration_filter():
+    taint = [{"key": "dedicated", "value": "gpu", "effect": "NoSchedule"}]
+    nodes = [build_test_node("n1", 1000, int(1e9), 10),
+             build_test_node("n2", 1000, int(1e9), 10, taints=taint)]
+    res = _run(build_test_pod("p", 100, 0), nodes)
+    assert set(res.per_node_counts) == {"n1"}
+    assert res.fail_counts.get(
+        "node(s) had untolerated taint {dedicated: gpu}") == 1
+
+    # Tolerating pod uses both nodes.
+    pod = build_test_pod("p2", 100, 0)
+    pod["spec"]["tolerations"] = [{"key": "dedicated", "operator": "Equal",
+                                   "value": "gpu", "effect": "NoSchedule"}]
+    res2 = _run(pod, nodes)
+    assert set(res2.per_node_counts) == {"n1", "n2"}
+
+
+def test_taint_prefer_no_schedule_scoring():
+    """PreferNoSchedule taints push pods away but don't block."""
+    taint = [{"key": "soft", "value": "x", "effect": "PreferNoSchedule"}]
+    nodes = [build_test_node("tainted", 10000, int(1e10), 100, taints=taint),
+             build_test_node("clean", 10000, int(1e10), 100)]
+    res = _run(build_test_pod("p", 100, 0), nodes, limit=1)
+    assert set(res.per_node_counts) == {"clean"}
+
+
+def test_node_selector():
+    nodes = [build_test_node("n1", 1000, int(1e9), 10, labels={"disk": "ssd"}),
+             build_test_node("n2", 1000, int(1e9), 10, labels={"disk": "hdd"})]
+    pod = build_test_pod("p", 100, 0)
+    pod["spec"]["nodeSelector"] = {"disk": "ssd"}
+    res = _run(pod, nodes)
+    assert set(res.per_node_counts) == {"n1"}
+    assert res.fail_counts.get(
+        "node(s) didn't match Pod's node affinity/selector") == 1
+
+
+def test_node_affinity_required_expressions():
+    nodes = [build_test_node("n1", 1000, int(1e9), 10, labels={"zone": "a"}),
+             build_test_node("n2", 1000, int(1e9), 10, labels={"zone": "b"}),
+             build_test_node("n3", 1000, int(1e9), 10)]
+    pod = build_test_pod("p", 100, 0)
+    pod["spec"]["affinity"] = {"nodeAffinity": {
+        "requiredDuringSchedulingIgnoredDuringExecution": {
+            "nodeSelectorTerms": [{"matchExpressions": [
+                {"key": "zone", "operator": "In", "values": ["a", "b"]}]}],
+        }}}
+    res = _run(pod, nodes)
+    assert set(res.per_node_counts) == {"n1", "n2"}
+
+
+def test_node_affinity_preferred_steers():
+    nodes = [build_test_node("plain", 10000, int(1e10), 100),
+             build_test_node("preferred", 10000, int(1e10), 100,
+                             labels={"tier": "gold"})]
+    pod = build_test_pod("p", 100, 0)
+    pod["spec"]["affinity"] = {"nodeAffinity": {
+        "preferredDuringSchedulingIgnoredDuringExecution": [
+            {"weight": 100, "preference": {"matchExpressions": [
+                {"key": "tier", "operator": "In", "values": ["gold"]}]}}],
+    }}
+    res = _run(pod, nodes, limit=1)
+    assert set(res.per_node_counts) == {"preferred"}
+
+
+def test_host_ports():
+    nodes = [build_test_node("n1", 10000, int(1e10), 100),
+             build_test_node("n2", 10000, int(1e10), 100)]
+    pod = build_test_pod("p", 10, 0)
+    pod["spec"]["containers"][0]["ports"] = [{"containerPort": 80,
+                                              "hostPort": 8080}]
+    res = _run(pod, nodes)
+    # one pod per node — the hostPort conflicts with itself
+    assert res.placed_count == 2
+    assert all(v == 1 for v in res.per_node_counts.values())
+    assert res.fail_counts.get(
+        "node(s) didn't have free ports for the requested pod ports") == 2
+
+    # existing pod occupying the port blocks its node
+    existing = build_test_pod("occupant", 10, 0, node_name="n1")
+    existing["spec"]["containers"][0]["ports"] = [{"containerPort": 80,
+                                                   "hostPort": 8080}]
+    res2 = _run(pod, nodes, existing=[existing])
+    assert set(res2.per_node_counts) == {"n2"}
+
+
+def test_topology_spread_hard():
+    """maxSkew=1 over zones → balanced placement across zones."""
+    nodes = []
+    for zi, zone in enumerate(("a", "b", "c")):
+        for i in range(2):
+            nodes.append(build_test_node(
+                f"n{zone}{i}", 100000, int(1e11), 1000,
+                labels={"topology.kubernetes.io/zone": zone,
+                        "kubernetes.io/hostname": f"n{zone}{i}"}))
+    pod = build_test_pod("p", 10, 0, labels={"app": "web"})
+    pod["spec"]["topologySpreadConstraints"] = [{
+        "maxSkew": 1, "topologyKey": "topology.kubernetes.io/zone",
+        "whenUnsatisfiable": "DoNotSchedule",
+        "labelSelector": {"matchLabels": {"app": "web"}},
+    }]
+    res = _run(pod, nodes, limit=30)
+    assert res.placed_count == 30
+    zone_counts = {}
+    for name, cnt in res.per_node_counts.items():
+        zone_counts[name[1]] = zone_counts.get(name[1], 0) + cnt
+    assert max(zone_counts.values()) - min(zone_counts.values()) <= 1
+
+
+def test_topology_spread_missing_label():
+    nodes = [build_test_node("z1", 1000, int(1e9), 10,
+                             labels={"zone": "a"}),
+             build_test_node("nolabel", 1000, int(1e9), 10)]
+    pod = build_test_pod("p", 100, 0, labels={"app": "web"})
+    pod["spec"]["topologySpreadConstraints"] = [{
+        "maxSkew": 1, "topologyKey": "zone",
+        "whenUnsatisfiable": "DoNotSchedule",
+        "labelSelector": {"matchLabels": {"app": "web"}},
+    }]
+    res = _run(pod, nodes)
+    assert "nolabel" not in res.per_node_counts
+    assert res.fail_counts.get(
+        "node(s) didn't match pod topology spread constraints "
+        "(missing required label)") == 1

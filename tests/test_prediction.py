@@ -1,0 +1,78 @@
+"""Parity tests mirroring TestPrediction
+(/root/reference/pkg/framework/simulator_test.go:154-259) and the README
+demonstration scenario."""
+
+from cluster_capacity_tpu import ClusterCapacity, SchedulerProfile
+from cluster_capacity_tpu.models.podspec import default_pod
+
+from helpers import (build_test_node, prediction_pod, setup_prediction_nodes)
+
+
+def _run(pod, nodes, limit=0):
+    cc = ClusterCapacity(default_pod(pod), max_limit=limit,
+                         profile=SchedulerProfile.parity())
+    cc.sync_with_objects(nodes)
+    return cc, cc.run()
+
+
+def test_limit_reached():
+    cc, res = _run(prediction_pod(), setup_prediction_nodes(), limit=6)
+    assert res.fail_type == "LimitReached"
+    assert res.placed_count == 6
+    assert res.fail_message == "Maximum number of pods simulated: 6"
+
+
+def test_unschedulable():
+    cc, res = _run(prediction_pod(), setup_prediction_nodes(), limit=0)
+    assert res.fail_type == "Unschedulable"
+    # 3 pod slots per node; every node runs out of pod slots, node-1 also out
+    # of cpu (300m == 3x100m exactly consumed).
+    assert res.placed_count == 9
+    assert res.fail_counts.get("Too many pods") == 3
+    assert res.fail_counts.get("Insufficient cpu") == 1
+    assert res.fail_message == \
+        "0/3 nodes are available: 1 Insufficient cpu, 3 Too many pods."
+
+
+def test_readme_demo():
+    """README 'Demonstration': 4 nodes x 2cpu/4GB, 150m/100Mi pod → 52 total,
+    13 per node."""
+    nodes = [build_test_node(f"kube-node-{i}", 2000, 4 * 1024 ** 3, 110)
+             for i in range(1, 5)]
+    pod = {
+        "metadata": {"name": "small-pod", "labels": {"app": "guestbook"}},
+        "spec": {"containers": [{
+            "name": "php-redis",
+            "image": "gcr.io/google-samples/gb-frontend:v4",
+            "resources": {"requests": {"cpu": "150m", "memory": "100Mi"},
+                          "limits": {"cpu": "500m", "memory": "128Mi"}}}]},
+    }
+    cc, res = _run(pod, nodes)
+    assert res.placed_count == 52
+    assert res.per_node_counts == {f"kube-node-{i}": 13 for i in range(1, 5)}
+    assert res.fail_message == "0/4 nodes are available: 4 Insufficient cpu."
+
+
+def test_excluded_nodes():
+    nodes = setup_prediction_nodes()
+    cc = ClusterCapacity(default_pod(prediction_pod()), max_limit=0,
+                         profile=SchedulerProfile.parity(),
+                         exclude_nodes=["test-node-3"])
+    cc.sync_with_objects(nodes)
+    res = cc.run()
+    assert res.placed_count == 6
+    assert set(res.per_node_counts) == {"test-node-1", "test-node-2"}
+
+
+def test_existing_pods_consume_capacity():
+    """SyncWithClient copies existing non-terminal pods; they reduce headroom."""
+    from helpers import build_test_pod
+    nodes = [build_test_node("n1", 1000, int(1e9), 10)]
+    existing = [build_test_pod("busy", 800, 0, node_name="n1"),
+                build_test_pod("done", 900, 0, node_name="n1")]
+    existing[1]["status"] = {"phase": "Succeeded"}  # terminal → filtered out
+    pod = build_test_pod("new", 100, 0)
+    cc = ClusterCapacity(default_pod(pod), profile=SchedulerProfile.parity())
+    cc.sync_with_objects(nodes, existing)
+    res = cc.run()
+    assert res.placed_count == 2  # 1000 - 800 = 200 → two 100m pods
