@@ -1,0 +1,81 @@
+"""Benchmark driver: simulated pod placements/sec at 10k nodes (BASELINE.md).
+
+Runs the flagship solve — a 10k-node heterogeneous snapshot, default plugin
+weights with taints + zones, single podspec — on the default JAX platform (the
+real TPU chip when available), and prints ONE json line.
+
+vs_baseline: the reference publishes no benchmark numbers (BASELINE.md); the
+comparison point is the commonly-cited kube-scheduler steady-state throughput
+of ~100 bindings/sec on large clusters (its 100ms/pod slow-cycle trace
+threshold, schedule_one.go:431-432, marks slower cycles as outliers).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+N_NODES = int(os.environ.get("BENCH_NODES", "10000"))
+N_PLACEMENTS = int(os.environ.get("BENCH_PLACEMENTS", "4096"))
+BASELINE_PLACEMENTS_PER_SEC = 100.0
+
+
+def build_problem():
+    from cluster_capacity_tpu.engine.encode import encode_problem
+    from cluster_capacity_tpu.models.podspec import default_pod
+    from cluster_capacity_tpu.models.snapshot import ClusterSnapshot
+    from cluster_capacity_tpu.utils.config import SchedulerProfile
+
+    rng = np.random.RandomState(0)
+    nodes = []
+    for i in range(N_NODES):
+        taints = []
+        if i % 17 == 0:
+            taints = [{"key": "dedicated", "value": "batch",
+                       "effect": "NoSchedule"}]
+        nodes.append({
+            "metadata": {"name": f"node-{i:06d}",
+                         "labels": {"kubernetes.io/hostname": f"node-{i:06d}",
+                                    "topology.kubernetes.io/zone": f"zone-{i % 16}"}},
+            "spec": {"taints": taints} if taints else {},
+            "status": {"allocatable": {
+                "cpu": f"{int(rng.choice([8000, 16000, 32000]))}m",
+                "memory": str(int(rng.choice([32, 64, 128])) * 1024 ** 3),
+                "pods": "110"}},
+        })
+    pod = {
+        "metadata": {"name": "bench-pod", "labels": {"app": "bench"}},
+        "spec": {"containers": [{
+            "name": "c0", "image": "app:v1",
+            "resources": {"requests": {"cpu": "500m", "memory": "1Gi"}}}]},
+    }
+    snapshot = ClusterSnapshot.from_objects(nodes)
+    return encode_problem(snapshot, default_pod(pod), SchedulerProfile())
+
+
+def main() -> None:
+    from cluster_capacity_tpu.engine import simulator as sim
+
+    pb = build_problem()
+    chunk = 1024
+    # Warmup: compile the exact chunk length the timed run uses.
+    sim.solve(pb, max_limit=chunk, chunk_size=chunk)
+
+    t0 = time.perf_counter()
+    res = sim.solve(pb, max_limit=N_PLACEMENTS, chunk_size=chunk)
+    dt = time.perf_counter() - t0
+
+    pps = res.placed_count / dt
+    print(json.dumps({
+        "metric": f"pod_placements_per_sec_{N_NODES}_nodes",
+        "value": round(pps, 2),
+        "unit": "placements/s",
+        "vs_baseline": round(pps / BASELINE_PLACEMENTS_PER_SEC, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

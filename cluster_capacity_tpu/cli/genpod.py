@@ -126,12 +126,10 @@ def run(argv: Optional[List[str]] = None, prog: str = "genpod") -> int:
         else:
             kubeconf.load_kube_config(config_file=args.kubeconfig or None)
         api = client.CoreV1Api()
-        namespaces = [x.to_dict() for x in api.list_namespace().items]
-        limit_ranges = [x.to_dict() for x in
+        ser = client.ApiClient().sanitize_for_serialization
+        namespaces = [ser(x) for x in api.list_namespace().items]
+        limit_ranges = [ser(x) for x in
                         api.list_namespaced_limit_range(args.namespace).items]
-        from ..framework import _camelize
-        namespaces = [_camelize(x) for x in namespaces]
-        limit_ranges = [_camelize(x) for x in limit_ranges]
 
     try:
         pod = retrieve_namespace_pod(namespaces, limit_ranges, args.namespace)

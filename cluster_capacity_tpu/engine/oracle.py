@@ -1,0 +1,589 @@
+"""Sequential CPU oracle: an independent re-implementation of the scheduling
+semantics in plain Python integer arithmetic, used as the differential-parity
+target for the JAX engine (SURVEY.md §7.3 "CPU oracle + parity harness").
+
+This deliberately mirrors the *reference's* structure — per-pod cycle, per-node
+plugin loops, int64 score math (vendor/.../schedule_one.go:430-478 +
+runtime/framework.go:1137-1240) — rather than the tensorized engine's, so bugs
+in the encoding/scan path don't cancel out.  Shares only the low-level string
+matchers (models/labels.py).
+
+Not a performance path: O(pods x nodes x plugins) pure Python.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Mapping, Optional, Tuple
+
+from ..models import labels as lbl
+from ..models import podspec as ps
+from ..models.snapshot import ClusterSnapshot
+from ..utils.config import SchedulerProfile
+
+DNS = ("NoSchedule", "NoExecute")
+
+
+class OracleState:
+    """Mutable cluster state during a sequential simulation."""
+
+    def __init__(self, snapshot: ClusterSnapshot):
+        self.snapshot = snapshot
+        self.pods_by_node: List[List[dict]] = [list(p)
+                                               for p in snapshot.pods_by_node]
+
+    def requested(self, i: int) -> Dict[str, int]:
+        agg: Dict[str, int] = {}
+        for pod in self.pods_by_node[i]:
+            for k, v in ps.pod_requests(pod).items():
+                agg[k] = agg.get(k, 0) + v
+        return agg
+
+    def nonzero_requested(self, i: int) -> Tuple[int, int]:
+        cpu = mem = 0
+        for pod in self.pods_by_node[i]:
+            c, m = ps.pod_nonzero_cpu_mem(pod)
+            cpu += c
+            mem += m
+        return cpu, mem
+
+    def allocatable(self, i: int) -> Dict[str, int]:
+        out = {}
+        alloc = ((self.snapshot.nodes[i].get("status") or {})
+                 .get("allocatable")) or {}
+        from ..utils.quantity import int_value, milli_value
+        for name, q in alloc.items():
+            out[name] = milli_value(q) if name == "cpu" else int_value(q)
+        return out
+
+
+def _filter_node(state: OracleState, i: int, pod: dict,
+                 profile: SchedulerProfile) -> Optional[str]:
+    """Run the filter chain in default plugin order; return the fail reason
+    (first failing plugin) or None."""
+    snap = state.snapshot
+    spec = pod.get("spec") or {}
+    tols = ps.pod_tolerations(pod)
+
+    if profile.filter_enabled("NodeUnschedulable") and snap.node_unschedulable(i):
+        unsched_taint = {"key": "node.kubernetes.io/unschedulable",
+                         "effect": "NoSchedule"}
+        if not any(lbl.toleration_tolerates_taint(t, unsched_taint)
+                   for t in tols):
+            return "node(s) were unschedulable"
+
+    if profile.filter_enabled("NodeName"):
+        want = spec.get("nodeName") or ""
+        if want and snap.node_names[i] != want:
+            return "node(s) didn't match the requested node name"
+
+    if profile.filter_enabled("TaintToleration"):
+        taint = lbl.find_matching_untolerated_taint(snap.node_taints(i), tols, DNS)
+        if taint is not None:
+            return (f"node(s) had untolerated taint "
+                    f"{{{taint.get('key', '')}: {taint.get('value', '')}}}")
+
+    if profile.filter_enabled("NodeAffinity"):
+        if not lbl.pod_matches_node_selector_and_affinity(
+                spec, snap.node_labels(i), snap.node_names[i]):
+            return "node(s) didn't match Pod's node affinity/selector"
+
+    if profile.filter_enabled("NodePorts"):
+        want = ps.pod_host_ports(pod)
+        used = []
+        for p in state.pods_by_node[i]:
+            used.extend(ps.pod_host_ports(p))
+        for (wp, wip, wport) in want:
+            for (up, uip, uport) in used:
+                if wport == uport and wp == up and \
+                        (wip == "0.0.0.0" or uip == "0.0.0.0" or wip == uip):
+                    return ("node(s) didn't have free ports for the "
+                            "requested pod ports")
+
+    if profile.filter_enabled("NodeResourcesFit"):
+        reasons = _fit_reasons(state, i, pod)
+        if reasons:
+            return reasons[0]
+
+    if profile.filter_enabled("PodTopologySpread"):
+        r = _spread_filter(state, i, pod)
+        if r:
+            return r
+
+    if profile.filter_enabled("InterPodAffinity"):
+        r = _ipa_filter(state, i, pod)
+        if r:
+            return r
+    return None
+
+
+def _fit_reasons(state: OracleState, i: int, pod: dict) -> List[str]:
+    alloc = state.allocatable(i)
+    req = state.requested(i)
+    podreq = ps.pod_requests(pod)
+    out = []
+    if len(state.pods_by_node[i]) + 1 > alloc.get("pods", 0):
+        out.append("Too many pods")
+    for name, want in podreq.items():
+        if want <= 0:
+            continue
+        if want > alloc.get(name, 0) - req.get(name, 0):
+            out.append(f"Insufficient {name}")
+    return out
+
+
+# --- PodTopologySpread ------------------------------------------------------
+
+def _spread_constraints(pod: dict, action: str) -> List[dict]:
+    return [c for c in (pod.get("spec") or {}).get("topologySpreadConstraints")
+            or [] if (c.get("whenUnsatisfiable") or "DoNotSchedule") == action]
+
+
+def _spread_countable(state: OracleState, i: int, pod: dict,
+                      constraints: List[dict], c: dict) -> bool:
+    snap = state.snapshot
+    labels = snap.node_labels(i)
+    if not all((cc.get("topologyKey") or "") in labels for cc in constraints):
+        return False
+    if (c.get("nodeAffinityPolicy") or "Honor") == "Honor":
+        if not lbl.pod_matches_node_selector_and_affinity(
+                pod.get("spec") or {}, labels, snap.node_names[i]):
+            return False
+    if (c.get("nodeTaintsPolicy") or "Ignore") == "Honor":
+        if lbl.find_matching_untolerated_taint(
+                snap.node_taints(i), ps.pod_tolerations(pod), DNS) is not None:
+            return False
+    return True
+
+
+def _count_match(pods: List[dict], selector, namespace: str) -> int:
+    n = 0
+    for p in pods:
+        meta = p.get("metadata") or {}
+        if (meta.get("namespace") or "default") != namespace:
+            continue
+        if meta.get("deletionTimestamp"):
+            continue
+        if lbl.match_label_selector(selector, meta.get("labels") or {}):
+            n += 1
+    return n
+
+
+def _spread_filter(state: OracleState, i: int, pod: dict) -> Optional[str]:
+    constraints = _spread_constraints(pod, "DoNotSchedule")
+    if not constraints:
+        return None
+    snap = state.snapshot
+    ns = (pod.get("metadata") or {}).get("namespace") or "default"
+    pod_labels = (pod.get("metadata") or {}).get("labels") or {}
+    node_labels = snap.node_labels(i)
+
+    for ci, c in enumerate(constraints):
+        key = c.get("topologyKey") or ""
+        if key not in node_labels:
+            return ("node(s) didn't match pod topology spread constraints "
+                    "(missing required label)")
+        counts: Dict[str, int] = {}
+        for j in range(snap.num_nodes):
+            if not _spread_countable(state, j, pod, constraints, c):
+                continue
+            val = snap.node_labels(j).get(key)
+            counts[val] = counts.get(val, 0) + _count_match(
+                state.pods_by_node[j], c.get("labelSelector"), ns)
+        min_domains = int(c.get("minDomains") or 1)
+        if not counts:
+            min_match = 2**31 - 1
+        else:
+            min_match = min(counts.values())
+        if len(counts) < min_domains:
+            min_match = 0
+        self_match = 1 if lbl.match_label_selector(c.get("labelSelector"),
+                                                   pod_labels) else 0
+        match_num = counts.get(node_labels[key], 0)
+        if match_num + self_match - min_match > int(c.get("maxSkew", 1)):
+            return "node(s) didn't match pod topology spread constraints"
+    return None
+
+
+# --- InterPodAffinity -------------------------------------------------------
+
+def _ns_labels(state: OracleState) -> Dict[str, Mapping[str, str]]:
+    out = {}
+    for nso in state.snapshot.namespaces:
+        meta = nso.get("metadata") or {}
+        out[meta.get("name", "")] = meta.get("labels") or {}
+    return out
+
+
+def _term_matches(term: Mapping, owner_ns: str, candidate: Mapping,
+                  ns_labels) -> bool:
+    from ..ops.inter_pod_affinity import _term_matches_pod
+    return _term_matches_pod(term, owner_ns, candidate, ns_labels)
+
+
+def _req_terms(pod: Mapping, kind: str) -> List[Mapping]:
+    aff = (pod.get("spec") or {}).get("affinity") or {}
+    return (aff.get(kind) or {}).get(
+        "requiredDuringSchedulingIgnoredDuringExecution") or []
+
+
+def _ipa_filter(state: OracleState, i: int, pod: dict) -> Optional[str]:
+    snap = state.snapshot
+    ns_labels = _ns_labels(state)
+    owner_ns = (pod.get("metadata") or {}).get("namespace") or "default"
+    node_labels = snap.node_labels(i)
+    aff_terms = _req_terms(pod, "podAffinity")
+    anti_terms = _req_terms(pod, "podAntiAffinity")
+
+    # affinityCounts / antiAffinityCounts over all existing pods
+    aff_counts: Dict[Tuple[str, str], int] = {}
+    anti_counts: Dict[Tuple[str, str], int] = {}
+    for j in range(snap.num_nodes):
+        j_labels = snap.node_labels(j)
+        for p in state.pods_by_node[j]:
+            for terms, counts in ((aff_terms, aff_counts),
+                                  (anti_terms, anti_counts)):
+                for t in terms:
+                    key = t.get("topologyKey", "")
+                    if key in j_labels and _term_matches(t, owner_ns, p,
+                                                         ns_labels):
+                        pair = (key, j_labels[key])
+                        counts[pair] = counts.get(pair, 0) + 1
+
+    if aff_terms:
+        pods_exist = True
+        for t in aff_terms:
+            key = t.get("topologyKey", "")
+            if key not in node_labels:
+                return "node(s) didn't match pod affinity rules"
+            if aff_counts.get((key, node_labels[key]), 0) <= 0:
+                pods_exist = False
+        if not pods_exist:
+            pod_self = {"metadata": {
+                "namespace": owner_ns,
+                "labels": (pod.get("metadata") or {}).get("labels") or {}}}
+            escape = (not aff_counts) and all(
+                _term_matches(t, owner_ns, pod_self, ns_labels)
+                for t in aff_terms)
+            if not escape:
+                return "node(s) didn't match pod affinity rules"
+
+    for t in anti_terms:
+        key = t.get("topologyKey", "")
+        if key in node_labels and \
+                anti_counts.get((key, node_labels[key]), 0) > 0:
+            return "node(s) didn't match pod anti-affinity rules"
+
+    # existing pods' required anti-affinity vs incoming
+    for j in range(snap.num_nodes):
+        j_labels = snap.node_labels(j)
+        for p in state.pods_by_node[j]:
+            p_ns = (p.get("metadata") or {}).get("namespace") or "default"
+            for t in _req_terms(p, "podAntiAffinity"):
+                key = t.get("topologyKey", "")
+                if key not in j_labels:
+                    continue
+                if _term_matches(t, p_ns, pod, ns_labels):
+                    if node_labels.get(key) == j_labels[key]:
+                        return ("node(s) didn't satisfy existing pods "
+                                "anti-affinity rules")
+    return None
+
+
+# --- Scores ----------------------------------------------------------------
+
+def _score_nodes(state: OracleState, feasible: List[int], pod: dict,
+                 profile: SchedulerProfile) -> Dict[int, int]:
+    snap = state.snapshot
+    totals = {i: 0 for i in feasible}
+
+    w = profile.score_weight("NodeResourcesFit")
+    if w:
+        raw = {i: _fit_score(state, i, pod, profile) for i in feasible}
+        for i in feasible:
+            totals[i] += w * raw[i]
+
+    w = profile.score_weight("NodeResourcesBalancedAllocation")
+    if w:
+        for i in feasible:
+            totals[i] += w * _balanced_score(state, i, pod, profile)
+
+    w = profile.score_weight("TaintToleration")
+    if w:
+        raw = {i: lbl.count_intolerable_prefer_no_schedule(
+            snap.node_taints(i), ps.pod_tolerations(pod)) for i in feasible}
+        mx = max(raw.values(), default=0)
+        for i in feasible:
+            s = 100 * raw[i] // mx if mx > 0 else 0
+            totals[i] += w * (100 - s if mx > 0 else 100)
+
+    w = profile.score_weight("NodeAffinity")
+    aff = ((pod.get("spec") or {}).get("affinity") or {}).get("nodeAffinity") or {}
+    if w and aff.get("preferredDuringSchedulingIgnoredDuringExecution"):
+        raw = {i: lbl.preferred_node_affinity_score(
+            pod.get("spec") or {}, snap.node_labels(i), snap.node_names[i])
+            for i in feasible}
+        mx = max(raw.values(), default=0)
+        for i in feasible:
+            totals[i] += w * (100 * raw[i] // mx if mx > 0 else raw[i])
+
+    w = profile.score_weight("ImageLocality")
+    if w:
+        from ..ops.image_locality import static_score
+        raw = static_score(snap, pod)
+        for i in feasible:
+            totals[i] += w * int(raw[i])
+
+    w = profile.score_weight("PodTopologySpread")
+    if w and _spread_constraints(pod, "ScheduleAnyway"):
+        raw = _spread_scores(state, feasible, pod)
+        for i in feasible:
+            totals[i] += w * raw[i]
+
+    w = profile.score_weight("InterPodAffinity")
+    if w:
+        raw = _ipa_scores(state, feasible, pod)
+        if raw is not None:
+            for i in feasible:
+                totals[i] += w * raw[i]
+    return totals
+
+
+def _fit_score(state: OracleState, i: int, pod: dict,
+               profile: SchedulerProfile) -> int:
+    alloc = state.allocatable(i)
+    req = state.requested(i)
+    nz_cpu, nz_mem = state.nonzero_requested(i)
+    podreq = ps.pod_requests(pod, non_missing_defaults=True)
+    podreq_actual = ps.pod_requests(pod)
+
+    node_score = 0
+    weight_sum = 0
+    for name, weight in profile.fit_strategy.resources:
+        if ps.is_scalar_resource_name(name) and not podreq.get(name, 0):
+            continue
+        a = alloc.get(name, 0)
+        if a == 0:
+            continue
+        if name == "cpu":
+            r = nz_cpu + podreq.get("cpu", 0)
+        elif name == "memory":
+            r = nz_mem + podreq.get("memory", 0)
+        else:
+            r = req.get(name, 0) + podreq_actual.get(name, 0)
+        if profile.fit_strategy.type == "MostAllocated":
+            rs = min(r, a) * 100 // a
+        else:
+            rs = 0 if r > a else (a - r) * 100 // a
+        node_score += rs * weight
+        weight_sum += weight
+    return node_score // weight_sum if weight_sum else 0
+
+
+def _balanced_score(state: OracleState, i: int, pod: dict,
+                    profile: SchedulerProfile) -> int:
+    alloc = state.allocatable(i)
+    req = state.requested(i)
+    podreq = ps.pod_requests(pod)
+    fractions = []
+    for name, _w in profile.balanced_resources:
+        if ps.is_scalar_resource_name(name) and not podreq.get(name, 0):
+            continue
+        a = alloc.get(name, 0)
+        if a == 0:
+            continue
+        fractions.append(min((req.get(name, 0) + podreq.get(name, 0)) / a, 1.0))
+    if len(fractions) == 2:
+        std = abs(fractions[0] - fractions[1]) / 2
+    elif len(fractions) > 2:
+        mean = sum(fractions) / len(fractions)
+        std = math.sqrt(sum((f - mean) ** 2 for f in fractions) / len(fractions))
+    else:
+        std = 0.0
+    return int((1 - std) * 100)
+
+
+def _spread_scores(state: OracleState, feasible: List[int],
+                   pod: dict) -> Dict[int, int]:
+    snap = state.snapshot
+    ns = (pod.get("metadata") or {}).get("namespace") or "default"
+    constraints = _spread_constraints(pod, "ScheduleAnyway")
+    require_all = bool((pod.get("spec") or {}).get("topologySpreadConstraints"))
+    ignored = set()
+    for i in feasible:
+        labels = snap.node_labels(i)
+        if require_all and not all((c.get("topologyKey") or "") in labels
+                                   for c in constraints):
+            ignored.add(i)
+
+    raw: Dict[int, float] = {}
+    sizes: List[int] = []
+    counts_per_c: List[Dict[str, int]] = []
+    for c in constraints:
+        key = c.get("topologyKey") or ""
+        domains = set()
+        for i in feasible:
+            if i in ignored:
+                continue
+            val = snap.node_labels(i).get(key)
+            if val is not None:
+                domains.add(val)
+        counts: Dict[str, int] = {}
+        for j in range(snap.num_nodes):
+            if not _spread_countable(state, j, pod, constraints, c):
+                continue
+            val = snap.node_labels(j).get(key)
+            if val in domains:
+                counts[val] = counts.get(val, 0) + _count_match(
+                    state.pods_by_node[j], c.get("labelSelector"), ns)
+        counts_per_c.append(counts)
+        if key == "kubernetes.io/hostname":
+            sizes.append(len(feasible) - len(ignored))
+        else:
+            sizes.append(len(domains))
+
+    for i in feasible:
+        if i in ignored:
+            raw[i] = 0
+            continue
+        labels = snap.node_labels(i)
+        score = 0.0
+        for ci, c in enumerate(constraints):
+            key = c.get("topologyKey") or ""
+            if key not in labels:
+                continue
+            if key == "kubernetes.io/hostname":
+                cnt = _count_match(state.pods_by_node[i],
+                                   c.get("labelSelector"), ns)
+            else:
+                cnt = counts_per_c[ci].get(labels[key], 0)
+            tp_weight = math.log(sizes[ci] + 2)
+            score += cnt * tp_weight + (int(c.get("maxSkew", 1)) - 1)
+        raw[i] = int(round(score))
+
+    scored = [i for i in feasible if i not in ignored]
+    if not scored:
+        return {i: 0 for i in feasible}
+    mx = max(raw[i] for i in scored)
+    mn = min(raw[i] for i in scored)
+    out = {}
+    for i in feasible:
+        if i in ignored:
+            out[i] = 0
+        elif mx == 0:
+            out[i] = 100
+        else:
+            out[i] = 100 * (mx + mn - raw[i]) // mx
+    return out
+
+
+def _ipa_scores(state: OracleState, feasible: List[int],
+                pod: dict) -> Optional[Dict[int, int]]:
+    from ..ops.inter_pod_affinity import HARD_POD_AFFINITY_WEIGHT
+    snap = state.snapshot
+    ns_labels = _ns_labels(state)
+    owner_ns = (pod.get("metadata") or {}).get("namespace") or "default"
+    aff = (pod.get("spec") or {}).get("affinity") or {}
+
+    def pref(p, kind):
+        a = (p.get("spec") or {}).get("affinity") or {}
+        return (a.get(kind) or {}).get(
+            "preferredDuringSchedulingIgnoredDuringExecution") or []
+
+    has_constraints = bool(pref(pod, "podAffinity") or
+                           pref(pod, "podAntiAffinity"))
+    pair_scores: Dict[Tuple[str, str], float] = {}
+
+    def add(key, j, w):
+        val = snap.node_labels(j).get(key)
+        if val is not None:
+            pair_scores[(key, val)] = pair_scores.get((key, val), 0.0) + w
+
+    any_contrib = False
+    for j in range(snap.num_nodes):
+        for p in state.pods_by_node[j]:
+            p_ns = (p.get("metadata") or {}).get("namespace") or "default"
+            p_has_aff = bool((p.get("spec") or {}).get("affinity"))
+            if has_constraints:
+                for t in pref(pod, "podAffinity"):
+                    term = t.get("podAffinityTerm") or {}
+                    if _term_matches(term, owner_ns, p, ns_labels):
+                        add(term.get("topologyKey", ""), j,
+                            float(t.get("weight", 0)))
+                        any_contrib = True
+                for t in pref(pod, "podAntiAffinity"):
+                    term = t.get("podAffinityTerm") or {}
+                    if _term_matches(term, owner_ns, p, ns_labels):
+                        add(term.get("topologyKey", ""), j,
+                            -float(t.get("weight", 0)))
+                        any_contrib = True
+            if p_has_aff or has_constraints:
+                for term in _req_terms(p, "podAffinity"):
+                    if _term_matches(term, p_ns, pod, ns_labels):
+                        add(term.get("topologyKey", ""), j,
+                            HARD_POD_AFFINITY_WEIGHT)
+                        any_contrib = True
+                for t in pref(p, "podAffinity"):
+                    term = t.get("podAffinityTerm") or {}
+                    if _term_matches(term, p_ns, pod, ns_labels):
+                        add(term.get("topologyKey", ""), j,
+                            float(t.get("weight", 0)))
+                        any_contrib = True
+                for t in pref(p, "podAntiAffinity"):
+                    term = t.get("podAffinityTerm") or {}
+                    if _term_matches(term, p_ns, pod, ns_labels):
+                        add(term.get("topologyKey", ""), j,
+                            -float(t.get("weight", 0)))
+                        any_contrib = True
+    if not any_contrib:
+        return None
+
+    raw = {}
+    for i in feasible:
+        labels = snap.node_labels(i)
+        raw[i] = int(sum(w for (k, v), w in pair_scores.items()
+                         if labels.get(k) == v))
+    mx = max(raw.values())
+    mn = min(raw.values())
+    diff = mx - mn
+    return {i: int(100 * (raw[i] - mn) / diff) if diff > 0 else 0
+            for i in feasible}
+
+
+# --- Main loop --------------------------------------------------------------
+
+def simulate(snapshot: ClusterSnapshot, template: dict,
+             profile: Optional[SchedulerProfile] = None,
+             max_limit: int = 0):
+    """Sequential greedy simulation; returns (placements, fail_counts)."""
+    profile = profile or SchedulerProfile.parity()
+    state = OracleState(snapshot)
+    placements: List[int] = []
+    fail_counts: Dict[str, int] = {}
+    step = 0
+    while True:
+        if max_limit and len(placements) >= max_limit:
+            return placements, {}
+        feasible = []
+        reasons: Dict[str, int] = {}
+        for i in range(snapshot.num_nodes):
+            r = _filter_node(state, i, template, profile)
+            if r is None:
+                # fit contributes every insufficient resource; others one
+                feasible.append(i)
+        if not feasible:
+            for i in range(snapshot.num_nodes):
+                r = _filter_node(state, i, template, profile)
+                if r and r.startswith("Insufficient") or r == "Too many pods":
+                    for fr in _fit_reasons(state, i, template):
+                        reasons[fr] = reasons.get(fr, 0) + 1
+                elif r:
+                    reasons[r] = reasons.get(r, 0) + 1
+            return placements, reasons
+        totals = _score_nodes(state, feasible, template, profile)
+        best = max(feasible, key=lambda i: (totals[i], -i))
+        placements.append(best)
+        clone = ps.make_clone(template, step)
+        clone["spec"]["nodeName"] = snapshot.node_names[best]
+        state.pods_by_node[best].append(clone)
+        step += 1

@@ -375,12 +375,12 @@ def _step(cfg: StaticConfig, consts, carry: Carry):
             consts["ipa_self_anti"], chosen)
         anti_dyn = jnp.where(place, upd, carry.anti_dyn)
     if cfg.ipa_num_pref > 0:
-        # Both directions of processExistingPod apply between identical clones
-        # → 2x the term weight per placement (scoring.go:121-160).
+        # ipa_pref_w carries the pre-folded per-placement weight: 2x for soft
+        # terms (both directions of processExistingPod apply between identical
+        # clones), 1x HardPodAffinityWeight for required terms.
         upd = ipa_ops.placement_update(
             carry.pref_dyn, consts["ipa_dom"], consts["ipa_pref_group"],
-            consts["ipa_self_pref"], chosen,
-            weight=2.0 * consts["ipa_pref_w"])
+            consts["ipa_self_pref"], chosen, weight=consts["ipa_pref_w"])
         pref_dyn = jnp.where(place, upd, carry.pref_dyn)
 
     new_carry = Carry(
@@ -442,35 +442,34 @@ def solve(pb: enc.EncodedProblem, max_limit: int = 0,
     if max_limit and max_limit > 0:
         budget = min(max_limit, budget)
     budget = max(1, min(budget, _DEFAULT_UNLIMITED_CAP))
+    # Chunks always run at full length (steps no-op once stopped) so one
+    # compiled executable serves every solve of this shape; placements are
+    # trimmed to the budget afterwards.
+    chunk_size = min(chunk_size, budget)
 
     placements: List[int] = []
-    steps_done = 0
-    while steps_done < budget:
-        n = min(chunk_size, budget - steps_done)
-        carry, chosen = run_chunk(cfg, consts, carry, n)
+    while len(placements) < budget:
+        carry, chosen = run_chunk(cfg, consts, carry, chunk_size)
         chosen = np.asarray(chosen)
-        for c in chosen:
-            if c >= 0:
-                placements.append(int(c))
-        steps_done += n
+        placements.extend(chosen[chosen >= 0].tolist())
         if bool(np.asarray(carry.stopped)):
             break
-
+    placements = placements[:budget]
     placed = len(placements)
     stopped = bool(np.asarray(carry.stopped))
 
+    if max_limit and placed >= max_limit:
+        # postBindHook limit semantics (simulator.go:297-312).
+        return SolveResult(placements=placements, placed_count=placed,
+                           fail_type=FAIL_LIMIT_REACHED,
+                           fail_message=f"Maximum number of pods simulated: {max_limit}",
+                           node_names=pb.snapshot.node_names)
     if stopped:
         counts = diagnose(pb, cfg, consts, carry)
         msg = format_fit_error(pb.snapshot.num_nodes, counts)
         return SolveResult(placements=placements, placed_count=placed,
                            fail_type=FAIL_UNSCHEDULABLE, fail_message=msg,
                            fail_counts=counts,
-                           node_names=pb.snapshot.node_names)
-    if max_limit and placed >= max_limit:
-        # postBindHook limit semantics (simulator.go:297-312).
-        return SolveResult(placements=placements, placed_count=placed,
-                           fail_type=FAIL_LIMIT_REACHED,
-                           fail_message=f"Maximum number of pods simulated: {max_limit}",
                            node_names=pb.snapshot.node_names)
     # Internal step budget exhausted without a user limit (only reachable when
     # the fit filter is disabled, so the hint bound is not authoritative).

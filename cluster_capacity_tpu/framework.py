@@ -60,23 +60,14 @@ class ClusterCapacity:
 
 
 def _to_dict(obj):
+    """kubernetes-client model → plain k8s JSON dict.
+
+    Uses the client's own serializer (attribute_map-aware), which camelizes
+    struct field names only — never user-data map keys like labels, selector
+    keys, or taint keys."""
     if isinstance(obj, dict):
         return obj
-    to_dict = getattr(obj, "to_dict", None)
-    if to_dict:
-        return _camelize(to_dict())
+    if hasattr(obj, "to_dict"):
+        from kubernetes.client import ApiClient  # type: ignore
+        return ApiClient().sanitize_for_serialization(obj)
     raise TypeError(f"cannot convert {type(obj)} to dict")
-
-
-def _camelize(obj):
-    """kubernetes-client python dicts use snake_case keys; convert back."""
-    if isinstance(obj, dict):
-        out = {}
-        for k, v in obj.items():
-            parts = k.split("_")
-            key = parts[0] + "".join(p.title() for p in parts[1:])
-            out[key] = _camelize(v)
-        return out
-    if isinstance(obj, list):
-        return [_camelize(x) for x in obj]
-    return obj

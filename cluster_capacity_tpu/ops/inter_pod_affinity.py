@@ -34,6 +34,10 @@ REASON_AFFINITY = "node(s) didn't match pod affinity rules"
 REASON_ANTI_AFFINITY = "node(s) didn't match pod anti-affinity rules"
 REASON_EXISTING_ANTI = "node(s) didn't satisfy existing pods anti-affinity rules"
 
+# InterPodAffinityArgs.HardPodAffinityWeight default
+# (apis/config/v1/defaults.go:187-188).
+HARD_POD_AFFINITY_WEIGHT = 1.0
+
 
 def _term_namespaces(term: Mapping, owner_ns: str) -> Tuple[set, Optional[Mapping]]:
     """getNamespacesFromPodAffinityTerm: explicit namespaces, else the owner's
@@ -138,12 +142,21 @@ def encode(snapshot: ClusterSnapshot, pod: Mapping) -> AffinityEncoding:
                            dtype=np.int32)
     anti_group = np.asarray([group_of(t.get("topologyKey", "")) for t in anti_terms],
                             dtype=np.int32)
-    pref_terms = [(t.get("podAffinityTerm") or {}, float(t.get("weight", 0)))
+    # Score terms with their per-placement dynamic weights.  Soft terms apply
+    # in BOTH directions between identical clones (scoring.go:95-99 + :117-119)
+    # → 2x weight; existing pods' REQUIRED affinity terms score
+    # HardPodAffinityWeight (default 1, apis/config/v1/defaults.go:187-188) in
+    # direction (b) only (scoring.go:106-113) → 1x.
+    pref_terms = [(t.get("podAffinityTerm") or {},
+                   float(t.get("weight", 0)), 2.0 * float(t.get("weight", 0)))
                   for t in pref_aff] + \
-                 [(t.get("podAffinityTerm") or {}, -float(t.get("weight", 0)))
-                  for t in pref_anti]
+                 [(t.get("podAffinityTerm") or {},
+                   -float(t.get("weight", 0)), -2.0 * float(t.get("weight", 0)))
+                  for t in pref_anti] + \
+                 [(t, HARD_POD_AFFINITY_WEIGHT, HARD_POD_AFFINITY_WEIGHT)
+                  for t in aff_terms]
     pref_group = np.asarray([group_of(t.get("topologyKey", ""))
-                             for t, _ in pref_terms], dtype=np.int32)
+                             for t, _, _ in pref_terms], dtype=np.int32)
 
     g = max(len(keys), 1)
     # Domain vocab per group.
@@ -199,30 +212,42 @@ def encode(snapshot: ClusterSnapshot, pod: Mapping) -> AffinityEncoding:
             existing_anti_static[i] = any(labels.get(k) == v
                                           for k, v in blocked_pairs)
 
-    # Preferred terms: static contributions from existing pods (both
-    # directions), dynamic handled through carried per-term domain weights.
+    # Score-term static contributions from existing pods (processExistingPod,
+    # scoring.go:81-125); dynamic contributions from placed clones go through
+    # the carried per-term domain weights.
     static_pref = np.zeros(n, dtype=np.float64)
     pair_scores: Dict[Tuple[str, str], float] = {}
+    soft_terms = [(t.get("podAffinityTerm") or {}, float(t.get("weight", 0)))
+                  for t in pref_aff] + \
+                 [(t.get("podAffinityTerm") or {}, -float(t.get("weight", 0)))
+                  for t in pref_anti]
 
     def add_pair(key: str, node_idx: int, weight: float):
         val = snapshot.node_labels(node_idx).get(key)
         if val is not None:
             pair_scores[(key, val)] = pair_scores.get((key, val), 0.0) + weight
 
-    has_pref_constraints = bool(pref_terms)
+    has_pref_constraints = bool(soft_terms)
     for i in range(n):
         for p in snapshot.pods_by_node[i]:
             p_ns = (p.get("metadata") or {}).get("namespace") or "default"
             p_has_affinity = bool((p.get("spec") or {}).get("affinity"))
-            # (a) incoming pod's preferred terms vs this existing pod.
+            # (a) incoming pod's preferred terms vs this existing pod
+            # (scoring.go:93-103).
             if has_pref_constraints:
-                for term, w in pref_terms:
+                for term, w in soft_terms:
                     if _term_matches_pod(term, owner_ns, p, ns_labels):
                         add_pair(term.get("topologyKey", ""), i, w)
-            # (b) this existing pod's preferred terms vs the incoming pod.
-            # Processed when the pod has any affinity, or always when the
-            # incoming pod has preferred constraints (scoring.go:219-227).
+            # (b) this existing pod's terms vs the incoming pod — processed
+            # when the pod has any affinity, or always when the incoming pod
+            # has preferred constraints (scoring.go:145-160, 219-227).
             if p_has_affinity or has_pref_constraints:
+                # required affinity terms score HardPodAffinityWeight
+                # (scoring.go:106-113).
+                for term in _required_terms(p, "podAffinity"):
+                    if _term_matches_pod(term, p_ns, pod, ns_labels):
+                        add_pair(term.get("topologyKey", ""), i,
+                                 HARD_POD_AFFINITY_WEIGHT)
                 for t in _preferred_terms(p, "podAffinity"):
                     term = t.get("podAffinityTerm") or {}
                     if _term_matches_pod(term, p_ns, pod, ns_labels):
@@ -240,7 +265,7 @@ def encode(snapshot: ClusterSnapshot, pod: Mapping) -> AffinityEncoding:
                                  if labels.get(k) == v)
 
     self_pref = np.asarray([_term_matches_pod(t, owner_ns, pod_self, ns_labels)
-                            for t, _ in pref_terms] or [False], dtype=bool)
+                            for t, _, _ in pref_terms] or [False], dtype=bool)
 
     return AffinityEncoding(
         num_aff_terms=len(aff_terms), num_anti_terms=len(anti_terms),
@@ -253,7 +278,7 @@ def encode(snapshot: ClusterSnapshot, pod: Mapping) -> AffinityEncoding:
         escape_allowed=escape, existing_anti_static=existing_anti_static,
         num_pref_terms=len(pref_terms),
         pref_group=pref_group if pref_terms else np.zeros(1, np.int32),
-        pref_weight=np.asarray([w for _, w in pref_terms] or [0.0]),
+        pref_weight=np.asarray([dw for _, _, dw in pref_terms] or [0.0]),
         self_pref_match=self_pref,
         static_pref_score=static_pref,
         has_any_score_terms=bool(pref_terms) or bool(pair_scores),

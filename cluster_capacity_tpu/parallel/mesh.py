@@ -1,0 +1,109 @@
+"""Device-mesh construction and sharding specs for the capacity solve.
+
+The reference's parallelism is 16 goroutines chunked over the node axis
+(vendor/.../scheduler/framework/parallelize/parallelism.go:28,43-51) plus an
+async bind pipeline.  The TPU-native equivalent (SURVEY.md §2d): shard the
+node axis across chips of a `jax.sharding.Mesh`; XLA inserts the ICI
+collectives (psum for feasible counts, global argmax for host selection) when
+the jitted solve consumes sharded arrays.  A second mesh axis batches what-if
+pod templates (the genpod sweep use case) — the data-parallel analog.
+
+Sharding layout ("nodes" = model/tensor axis, "batch" = data axis):
+- allocatable/requested [N, R]      → P("nodes", None)
+- per-node masks/scores [N]         → P("nodes")
+- per-constraint domain maps [C, N] → P(None, "nodes")
+- carried domain counts [C, D]      → replicated (small; updated by scatter)
+- batched template tensors [B, ...] → P("batch", ...)
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+NODE_AXIS = "nodes"
+BATCH_AXIS = "batch"
+
+
+def make_mesh(n_node_shards: Optional[int] = None, n_batch_shards: int = 1,
+              devices: Optional[Sequence] = None):
+    """Build a (batch, nodes) mesh over the available devices."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = list(devices if devices is not None else jax.devices())
+    if n_node_shards is None:
+        n_node_shards = len(devs) // n_batch_shards
+    used = n_node_shards * n_batch_shards
+    if used > len(devs):
+        raise ValueError(f"mesh {n_batch_shards}x{n_node_shards} needs {used} "
+                         f"devices, have {len(devs)}")
+    grid = np.asarray(devs[:used]).reshape(n_batch_shards, n_node_shards)
+    return Mesh(grid, (BATCH_AXIS, NODE_AXIS))
+
+
+def consts_shardings(mesh, consts: Dict[str, "jax.Array"],
+                     batched: bool = False) -> Dict[str, "jax.sharding.NamedSharding"]:
+    """NamedSharding per consts entry (see build_consts in engine/simulator)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def spec(*parts):
+        if batched:
+            return NamedSharding(mesh, P(BATCH_AXIS, *parts))
+        return NamedSharding(mesh, P(*parts))
+
+    node_mat = {"allocatable"}
+    node_vec = {"static_mask", "taint_raw", "na_raw", "il_score",
+                "ss_ignored", "ipa_eanti_static", "ipa_static_pref"}
+    cons_by_node = {"sh_dom", "sh_countable", "ss_dom", "ss_countable",
+                    "ss_node_existing", "ipa_dom"}
+    out = {}
+    for k, v in consts.items():
+        rank = v.ndim - (1 if batched else 0)   # per-problem rank
+        if k in node_mat:
+            out[k] = spec(NODE_AXIS, None)
+        elif k in node_vec:
+            out[k] = spec(NODE_AXIS)
+        elif k in cons_by_node:
+            out[k] = spec(None, NODE_AXIS)
+        else:
+            out[k] = spec(*([None] * rank))
+    return out
+
+
+def carry_shardings(mesh, carry, batched: bool = False):
+    """NamedSharding pytree matching engine.simulator.Carry."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def spec(*parts):
+        if batched:
+            return NamedSharding(mesh, P(BATCH_AXIS, *parts))
+        return NamedSharding(mesh, P(*parts))
+
+    return type(carry)(
+        requested=spec(NODE_AXIS, None),
+        nonzero=spec(NODE_AXIS, None),
+        placed=spec(NODE_AXIS),
+        spread_hard=spec(None, None),
+        spread_soft=spec(None, None),
+        aff_dyn=spec(None, None),
+        anti_dyn=spec(None, None),
+        pref_dyn=spec(None, None),
+        placed_count=spec(),
+        stopped=spec(),
+        rng=NamedSharding(mesh, P()) if not batched else spec(None),
+    )
+
+
+def shard_consts(mesh, consts, batched: bool = False):
+    import jax
+    specs = consts_shardings(mesh, consts, batched=batched)
+    return {k: jax.device_put(v, specs[k]) for k, v in consts.items()}
+
+
+def shard_carry(mesh, carry, batched: bool = False):
+    import jax
+    specs = carry_shardings(mesh, carry, batched=batched)
+    return jax.tree.map(lambda x, s: jax.device_put(x, s), carry, specs,
+                        is_leaf=lambda x: hasattr(x, "shape"))

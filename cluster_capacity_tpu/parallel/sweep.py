@@ -1,0 +1,149 @@
+"""Batched what-if sweeps: many pod templates against one snapshot.
+
+The reference answers one podspec per process run; sweeping (the genpod use
+case, BASELINE.md config 3) costs a full simulator run per spec.  Here the
+sweep is a leading `vmap` axis over templates: per-template request vectors,
+static masks and static score vectors stack to [B, ...] tensors, and the scan
+engine runs all B greedy simulations in lockstep on device — sharded over a
+(batch, nodes) mesh when one is provided.
+
+This fast path covers templates whose constraints are batch-uniform in shape:
+resource requests, node selectors/affinity, taints/tolerations, images, host
+ports vs existing pods (i.e. everything except per-template
+PodTopologySpread/InterPodAffinity tensors, whose domain shapes differ).
+Templates needing those fall back to the sequential engine automatically.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..engine import encode as enc
+from ..engine import simulator as sim
+from ..models.snapshot import ClusterSnapshot
+from ..utils.config import SchedulerProfile
+from . import mesh as mesh_lib
+
+
+def _batchable(pb: enc.EncodedProblem) -> bool:
+    return (pb.spread_hard.empty and pb.spread_soft.empty and
+            not pb.ipa.active and not pb.clone_has_host_ports)
+
+
+def sweep(snapshot: ClusterSnapshot, templates: Sequence[dict],
+          profile: Optional[SchedulerProfile] = None, max_limit: int = 0,
+          mesh=None) -> List[sim.SolveResult]:
+    """Solve capacity for every template; batched where possible."""
+    profile = profile or SchedulerProfile()
+    problems = [enc.encode_problem(snapshot, t, profile) for t in templates]
+
+    results: List[Optional[sim.SolveResult]] = [None] * len(templates)
+    # Group batchable templates by their StaticConfig — the jitted step
+    # specializes on it, so each group runs as one vmapped solve.
+    groups: Dict[tuple, List[int]] = {}
+    rest_idx: List[int] = []
+    for i, pb in enumerate(problems):
+        if _batchable(pb):
+            key = (sim.static_config(pb), pb.fit_res_idx.shape,
+                   pb.balanced_res_idx.shape, pb.req_vec.shape)
+            groups.setdefault(key, []).append(i)
+        else:
+            rest_idx.append(i)
+
+    for cfg_key, idxs in groups.items():
+        if len(idxs) == 1:
+            rest_idx.append(idxs[0])
+            continue
+        batch_results = _batched_solve([problems[i] for i in idxs],
+                                       max_limit=max_limit, mesh=mesh)
+        for i, r in zip(idxs, batch_results):
+            results[i] = r
+
+    for i in rest_idx:
+        results[i] = sim.solve(problems[i], max_limit=max_limit)
+    return results  # type: ignore[return-value]
+
+
+def _batched_solve(pbs: List[enc.EncodedProblem], max_limit: int,
+                   mesh=None) -> List[sim.SolveResult]:
+    import jax
+    import jax.numpy as jnp
+
+    sim._ensure_x64(pbs[0].profile)
+    cfg = sim.static_config(pbs[0])
+    consts_list = [sim.build_consts(pb) for pb in pbs]
+    carry_list = [sim._init_carry(pb, c, pb.profile.seed)
+                  for pb, c in zip(pbs, consts_list)]
+    consts = {k: jnp.stack([c[k] for c in consts_list])
+              for k in consts_list[0]}
+    carry = jax.tree.map(lambda *xs: jnp.stack(xs), *carry_list)
+
+    if mesh is not None:
+        consts = mesh_lib.shard_consts(mesh, consts, batched=True)
+        carry = mesh_lib.shard_carry(mesh, carry, batched=True)
+
+    budget = max(pb.max_steps_hint for pb in pbs) + 1
+    if max_limit and max_limit > 0:
+        budget = min(max_limit, budget)
+    budget = max(1, min(budget, sim._DEFAULT_UNLIMITED_CAP))
+
+    run_chunk = _batched_chunk_runner()
+    placements: List[List[int]] = [[] for _ in pbs]
+    steps_done = 0
+    chunk = min(1024, budget)
+    while steps_done < budget:
+        carry, chosen = run_chunk(cfg, consts, carry, chunk)   # chosen: [n, B]
+        chosen = np.asarray(chosen)
+        for b in range(len(pbs)):
+            col = chosen[:, b]
+            placements[b].extend(col[col >= 0].tolist())
+        steps_done += chunk
+        if bool(np.all(np.asarray(carry.stopped))):
+            break
+    if max_limit and max_limit > 0:
+        placements = [p[:max_limit] for p in placements]
+
+    results = []
+    stopped = np.asarray(carry.stopped)
+    for b, pb in enumerate(pbs):
+        placed = len(placements[b])
+        if max_limit and placed >= max_limit:
+            results.append(sim.SolveResult(
+                placements=placements[b], placed_count=placed,
+                fail_type=sim.FAIL_LIMIT_REACHED,
+                fail_message=f"Maximum number of pods simulated: {max_limit}",
+                node_names=pb.snapshot.node_names))
+        elif stopped[b]:
+            carry_b = jax.tree.map(lambda x: x[b], carry)
+            counts = sim.diagnose(pb, cfg, consts_list[b], carry_b)
+            msg = sim.format_fit_error(pb.snapshot.num_nodes, counts)
+            results.append(sim.SolveResult(
+                placements=placements[b], placed_count=placed,
+                fail_type=sim.FAIL_UNSCHEDULABLE, fail_message=msg,
+                fail_counts=counts, node_names=pb.snapshot.node_names))
+        else:
+            results.append(sim.SolveResult(
+                placements=placements[b], placed_count=placed,
+                fail_type=sim.FAIL_LIMIT_REACHED,
+                fail_message=(f"Simulation step budget exhausted after "
+                              f"{placed} placements"),
+                node_names=pb.snapshot.node_names))
+    return results
+
+
+@functools.lru_cache(maxsize=None)
+def _batched_chunk_runner():
+    import jax
+
+    @functools.partial(jax.jit, static_argnames=("cfg", "n"))
+    def run_chunk(cfg, consts, carry, n: int):
+        def body(c, _):
+            new_c, chosen = jax.vmap(
+                lambda cs, cc: sim._step(cfg, cs, cc))(consts, c)
+            return new_c, chosen
+        return jax.lax.scan(body, carry, None, length=n)
+
+    return run_chunk

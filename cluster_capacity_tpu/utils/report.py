@@ -100,8 +100,15 @@ def _resource_request(pod: Mapping) -> Dict:
     return out
 
 
-def build_review(templates: List[dict], result) -> ClusterCapacityReview:
-    """Build the review from a SolveResult (engine/simulator.py)."""
+def build_review(templates: List[dict], results) -> ClusterCapacityReview:
+    """Build the review from SolveResults (engine/simulator.py) — one result
+    per template, aligned by index.  A single result is accepted for the
+    single-template case."""
+    if not isinstance(results, (list, tuple)):
+        results = [results]
+    if len(results) != len(templates):
+        raise ValueError(f"{len(templates)} templates but {len(results)} results")
+
     reqs = [{
         "podName": (t.get("metadata") or {}).get("name", ""),
         "resources": _resource_request(t),
@@ -109,14 +116,12 @@ def build_review(templates: List[dict], result) -> ClusterCapacityReview:
     } for t in templates]
 
     pods: List[PodResult] = []
-    for ti, t in enumerate(templates):
+    for t, result in zip(templates, results):
         pr = PodResult(pod_name=(t.get("metadata") or {}).get("name", ""))
         # first-seen node order, as parsePodsReview (report.go:146-180)
         order: List[str] = []
         counts: Dict[str, int] = {}
-        for i, node_idx in enumerate(result.placements):
-            if i % len(templates) != ti:
-                continue
+        for node_idx in result.placements:
             name = result.node_names[node_idx]
             if name not in counts:
                 order.append(name)
@@ -128,14 +133,13 @@ def build_review(templates: List[dict], result) -> ClusterCapacityReview:
                                for k, v in sorted(result.fail_counts.items())]
         pods.append(pr)
 
-    fail_type = result.fail_type
-    fail_message = result.fail_message
+    first = results[0]
     return ClusterCapacityReview(
         templates=[copy.deepcopy(t) for t in templates],
         pod_requirements=reqs,
-        replicas=result.placed_count,
-        fail_type=fail_type,
-        fail_message=fail_message,
+        replicas=sum(r.placed_count for r in results),
+        fail_type=first.fail_type,
+        fail_message=first.fail_message,
         pods=pods,
         creation_timestamp=datetime.now(timezone.utc).isoformat(),
     )

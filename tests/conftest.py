@@ -4,7 +4,9 @@ math bit-exactly (the parity protocol in BASELINE.md)."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# NB: this jax build ignores the JAX_PLATFORMS env var (the axon TPU plugin
+# wins); JAX_PLATFORM_NAME / jax.config work.
+os.environ.setdefault("JAX_PLATFORM_NAME", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags +
@@ -12,4 +14,5 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)

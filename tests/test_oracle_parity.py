@@ -1,0 +1,96 @@
+"""Differential parity: the tensorized JAX engine vs the independent
+sequential CPU oracle (engine/oracle.py) on randomized clusters — the parity
+harness of SURVEY.md §7.3.  Placement SEQUENCES must match exactly (same node,
+same order), not just counts."""
+
+import numpy as np
+import pytest
+
+from cluster_capacity_tpu import SchedulerProfile
+from cluster_capacity_tpu.engine import encode as enc
+from cluster_capacity_tpu.engine import oracle
+from cluster_capacity_tpu.engine import simulator as sim
+from cluster_capacity_tpu.models.podspec import default_pod
+from cluster_capacity_tpu.models.snapshot import ClusterSnapshot
+
+from helpers import build_test_node, build_test_pod
+
+ZONES = ["zone-a", "zone-b", "zone-c"]
+
+
+def random_cluster(rng: np.random.RandomState, n_nodes: int):
+    nodes = []
+    pods = []
+    for i in range(n_nodes):
+        labels = {"kubernetes.io/hostname": f"n{i:03d}",
+                  "topology.kubernetes.io/zone": ZONES[int(rng.randint(3))]}
+        if rng.rand() < 0.3:
+            labels["disk"] = rng.choice(["ssd", "hdd"])
+        taints = []
+        if rng.rand() < 0.2:
+            taints = [{"key": "dedicated", "value": "x",
+                       "effect": str(rng.choice(
+                           ["NoSchedule", "PreferNoSchedule"]))}]
+        node = build_test_node(
+            f"n{i:03d}", int(rng.choice([1000, 2000, 4000])),
+            int(rng.choice([2, 4, 8])) * 1024 ** 3,
+            int(rng.choice([5, 10, 20])), labels=labels, taints=taints)
+        nodes.append(node)
+        for k in range(int(rng.randint(3))):
+            pods.append(build_test_pod(
+                f"existing-{i}-{k}", int(rng.choice([0, 100, 250])),
+                int(rng.choice([0, 256, 512])) * 1024 ** 2,
+                node_name=f"n{i:03d}",
+                labels={"app": str(rng.choice(["web", "db", "cache"]))}))
+    return nodes, pods
+
+
+def random_pod(rng: np.random.RandomState):
+    pod = build_test_pod("target", int(rng.choice([50, 150, 300])),
+                         int(rng.choice([64, 128, 512])) * 1024 ** 2,
+                         labels={"app": "web"})
+    r = rng.rand()
+    if r < 0.25:
+        pod["spec"]["affinity"] = {"podAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": [{
+                "topologyKey": "topology.kubernetes.io/zone",
+                "labelSelector": {"matchLabels": {"app": "web"}}}]}}
+    elif r < 0.5:
+        pod["spec"]["affinity"] = {"podAntiAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": [{
+                "topologyKey": "kubernetes.io/hostname",
+                "labelSelector": {"matchLabels": {"app": "web"}}}]}}
+    elif r < 0.75:
+        pod["spec"]["topologySpreadConstraints"] = [{
+            "maxSkew": int(rng.choice([1, 2])),
+            "topologyKey": "topology.kubernetes.io/zone",
+            "whenUnsatisfiable": str(rng.choice(
+                ["DoNotSchedule", "ScheduleAnyway"])),
+            "labelSelector": {"matchLabels": {"app": "web"}}}]
+    if rng.rand() < 0.3:
+        pod["spec"]["tolerations"] = [{"key": "dedicated",
+                                       "operator": "Exists"}]
+    return pod
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_differential_random(seed):
+    rng = np.random.RandomState(seed)
+    nodes, pods = random_cluster(rng, n_nodes=int(rng.choice([5, 9, 14])))
+    pod = default_pod(random_pod(rng))
+    snapshot = ClusterSnapshot.from_objects(
+        nodes, pods, namespaces=[{"metadata": {"name": "default"}}])
+    profile = SchedulerProfile.parity()
+    limit = 40
+
+    expected, expected_reasons = oracle.simulate(snapshot, pod, profile,
+                                                 max_limit=limit)
+    pb = enc.encode_problem(snapshot, pod, profile)
+    got = sim.solve(pb, max_limit=limit)
+
+    assert got.placements == expected, (
+        f"seed={seed}: engine placed {got.placements} "
+        f"(names {[got.node_names[i] for i in got.placements]}), oracle "
+        f"{expected} ({[snapshot.node_names[i] for i in expected]})")
+    if len(expected) < limit and expected_reasons:
+        assert got.fail_counts == expected_reasons, f"seed={seed}"
