@@ -41,9 +41,10 @@ def build_parser(prog: str = "cluster-capacity") -> argparse.ArgumentParser:
     p.add_argument("--snapshot", default="",
                    help="Path to a cluster-snapshot YAML/JSON file "
                         "(offline alternative to --kubeconfig).")
-    p.add_argument("--podspec", required=False, default="",
+    p.add_argument("--podspec", action="append", default=[],
                    help="Path to JSON or YAML file containing pod definition. "
-                        "http(s):// URLs are accepted.")
+                        "http(s):// URLs are accepted. May be repeated: "
+                        "multiple podspecs run as one batched what-if sweep.")
     p.add_argument("--max-limit", dest="max_limit", type=int, default=0,
                    help="Number of instances of pod to be scheduled after "
                         "which analysis stops. By default unlimited.")
@@ -57,6 +58,12 @@ def build_parser(prog: str = "cluster-capacity") -> argparse.ArgumentParser:
                    help="Output format. One of: json|yaml.")
     p.add_argument("--parity", action="store_true",
                    help="Bit-exact kube-scheduler score arithmetic (float64).")
+    p.add_argument("--trace", action="store_true",
+                   help="Print phase trace spans (snapshotting / scan) to "
+                        "stderr, mirroring the reference's utiltrace spans.")
+    p.add_argument("--metrics", action="store_true",
+                   help="Dump scheduler metrics (Prometheus text format) to "
+                        "stderr after the run.")
     return p
 
 
@@ -99,25 +106,68 @@ def run(argv: Optional[List[str]] = None, prog: str = "cluster-capacity") -> int
               file=sys.stderr)
         return 1
 
-    pod = default_pod(parse_pod_text(_read_podspec(args.podspec)))
-    validate_pod(pod)
+    pods = []
+    for spec_path in args.podspec:
+        pod = default_pod(parse_pod_text(_read_podspec(spec_path)))
+        validate_pod(pod)
+        pods.append(pod)
 
     profile = (load_scheduler_config(args.default_config)
                if args.default_config else SchedulerProfile())
     if args.parity:
         profile.compute_dtype = "float64"
+    if args.trace:
+        from ..utils.trace import default_tracer
+        default_tracer.enable()
 
     exclude = [s for s in args.exclude_nodes.split(",") if s]
-    cc = ClusterCapacity(pod, max_limit=args.max_limit, profile=profile,
-                         exclude_nodes=exclude)
-    if args.snapshot:
-        objs = load_snapshot_objects(args.snapshot)
-        cc.sync_with_objects(objs.pop("nodes", []), objs.pop("pods", []), **objs)
-    else:
-        cc.sync_with_client(_load_live_cluster(args.kubeconfig))
 
-    cc.run()
-    print_review(cc.report(), verbose=args.verbose, fmt=args.output)
+    if len(pods) == 1:
+        cc = ClusterCapacity(pods[0], max_limit=args.max_limit,
+                             profile=profile, exclude_nodes=exclude)
+        if args.snapshot:
+            objs = load_snapshot_objects(args.snapshot)
+            cc.sync_with_objects(objs.pop("nodes", []), objs.pop("pods", []),
+                                 **objs)
+        else:
+            cc.sync_with_client(_load_live_cluster(args.kubeconfig))
+        cc.run()
+        review = cc.report()
+    else:
+        # batched what-if sweep over all templates against one snapshot
+        from ..models.snapshot import ClusterSnapshot
+        from ..parallel.sweep import sweep
+        from ..utils.report import build_review
+        if args.snapshot:
+            objs = load_snapshot_objects(args.snapshot)
+        else:
+            raise SystemExit("multi-podspec sweeps require --snapshot")
+        import time
+
+        from ..utils import metrics as metrics_mod
+        from ..utils.trace import SPAN_SNAPSHOT, SPAN_SOLVE, default_tracer
+        with default_tracer.span(SPAN_SNAPSHOT):
+            snapshot = ClusterSnapshot.from_objects(
+                objs.pop("nodes", []), objs.pop("pods", []),
+                exclude_nodes=exclude, **objs)
+        t0 = time.perf_counter()
+        with default_tracer.span(SPAN_SOLVE), default_tracer.profile():
+            results = sweep(snapshot, pods, profile=profile,
+                            max_limit=args.max_limit)
+        reg = metrics_mod.default_registry
+        for r in results:
+            reg.inc(metrics_mod.SCHEDULE_ATTEMPTS, amount=r.placed_count,
+                    result="scheduled", profile=profile.name)
+            if r.fail_type == "Unschedulable":
+                reg.inc(metrics_mod.SCHEDULE_ATTEMPTS, result="unschedulable",
+                        profile=profile.name)
+        reg.observe(metrics_mod.SCHEDULING_DURATION, time.perf_counter() - t0)
+        review = build_review(pods, results)
+
+    print_review(review, verbose=args.verbose, fmt=args.output)
+    if args.metrics:
+        from ..utils.metrics import default_registry
+        sys.stderr.write(default_registry.render())
     return 0
 
 

@@ -19,7 +19,7 @@ from ..models.snapshot import (ClusterSnapshot, IDX_CPU, IDX_EPHEMERAL, IDX_MEM,
                                IDX_PODS)
 from ..ops import (image_locality, inter_pod_affinity, node_affinity, node_name,
                    node_ports, node_unschedulable, pod_topology_spread,
-                   taint_toleration)
+                   taint_toleration, volumes)
 from ..utils.config import SchedulerProfile
 
 # Per-node failure reason codes (first failing plugin in default filter order:
@@ -38,6 +38,8 @@ CODE_SPREAD = 8
 CODE_IPA_AFFINITY = 9
 CODE_IPA_ANTI = 10
 CODE_IPA_EXISTING_ANTI = 11
+# (volume plugin failures flow through the separate volume_mask/volume_reasons
+# channel — they sit between fit and spread in diagnosis order)
 
 STATIC_REASONS = {
     CODE_UNSCHEDULABLE: node_unschedulable.REASON,
@@ -74,10 +76,19 @@ class EncodedProblem:
     balanced_req: np.ndarray       # f[Kb] — actual requests
 
     # static filter state
-    static_mask: np.ndarray        # bool[N]
+    static_mask: np.ndarray        # bool[N] — pre-fit static filters
     static_code: np.ndarray        # i32[N] — first static fail reason
     taint_reasons: List[Optional[str]]
     clone_has_host_ports: bool
+    # volume plugins: static post-fit mask + per-node reasons, plus clone
+    # self-conflict flags the engine applies dynamically
+    volume_mask: np.ndarray        # bool[N]
+    volume_reasons: List[Optional[str]]
+    volume_self_conflict: bool     # inline-disk clone self-conflict (per node)
+    rwop_self_conflict: bool       # RWOP PVC → one clone cluster-wide
+    # pod-level gate: PreFilter/PreEnqueue failure affecting every node
+    pod_level_reason: Optional[str]
+    pod_level_fail_type: str
 
     # static score state
     taint_raw: np.ndarray          # f[N]
@@ -161,6 +172,18 @@ def encode_problem(snapshot: ClusterSnapshot, pod: dict,
         fold(node_ports.static_mask(snapshot, pod), CODE_PORTS)
     static_mask = np.logical_and.reduce(masks) if masks else np.ones(n, dtype=bool)
 
+    # --- volume plugins (static, post-fit in plugin order) -------------------
+    vol = volumes.evaluate(snapshot, pod, enabled)
+    pod_level_reason = vol.pod_level_reason
+    pod_level_fail_type = "Unschedulable"
+    # PreEnqueue: SchedulingGates holds the pod before it ever enters a cycle
+    # (scheduling_gates.go:49); the reference simulator would wait forever —
+    # here it fails fast with the kubelet's condition wording.
+    if (pod.get("spec") or {}).get("schedulingGates"):
+        pod_level_reason = ("Scheduling is blocked due to non-empty "
+                            "scheduling gates")
+        pod_level_fail_type = "SchedulingGated"
+
     # --- static scores ------------------------------------------------------
     taint_raw = taint_toleration.static_raw_score(snapshot, pod) \
         if profile.score_weight("TaintToleration") else np.zeros(n)
@@ -206,8 +229,12 @@ def encode_problem(snapshot: ClusterSnapshot, pod: dict,
                 per_node = np.minimum(per_node,
                                       np.floor(np.maximum(free[:, j], 0.0)
                                                / req_vec[j]))
-    per_node = np.where(static_mask, per_node, 0.0)
+    per_node = np.where(static_mask & vol.mask, per_node, 0.0)
     hint = int(per_node.sum()) if np.isfinite(per_node.sum()) else 10 ** 6
+    if pod_level_reason:
+        hint = 0
+    elif vol.rwop_self_conflict:
+        hint = min(hint, 1)
 
     return EncodedProblem(
         snapshot=snapshot, pod=pod, profile=profile,
@@ -224,6 +251,11 @@ def encode_problem(snapshot: ClusterSnapshot, pod: dict,
         taint_reasons=taint_reasons,
         clone_has_host_ports=(enabled("NodePorts")
                               and node_ports.template_has_host_ports(pod)),
+        volume_mask=vol.mask, volume_reasons=vol.reasons,
+        volume_self_conflict=vol.self_disk_conflict,
+        rwop_self_conflict=vol.rwop_self_conflict,
+        pod_level_reason=pod_level_reason,
+        pod_level_fail_type=pod_level_fail_type,
         taint_raw=taint_raw, node_affinity_raw=na_raw,
         node_affinity_active=na_active, image_locality_score=il_score,
         spread_hard=spread_hard, spread_soft=spread_soft,

@@ -556,33 +556,68 @@ def simulate(snapshot: ClusterSnapshot, template: dict,
              profile: Optional[SchedulerProfile] = None,
              max_limit: int = 0):
     """Sequential greedy simulation; returns (placements, fail_counts)."""
+    from ..ops import volumes as vol_ops
+
     profile = profile or SchedulerProfile.parity()
     state = OracleState(snapshot)
     placements: List[int] = []
-    fail_counts: Dict[str, int] = {}
     step = 0
+    n = snapshot.num_nodes
+
+    if (template.get("spec") or {}).get("schedulingGates"):
+        return [], {"Scheduling is blocked due to non-empty scheduling "
+                    "gates": n}
+    verdict = vol_ops.evaluate(snapshot, template, profile.filter_enabled)
+    if verdict.pod_level_reason:
+        return [], {verdict.pod_level_reason: n}
+
+    placed_per_node = [0] * n
+    has_ports = bool(ps.pod_host_ports(template)) and \
+        profile.filter_enabled("NodePorts")
+    next_start = 0
+
+    from .simulator import _num_feasible_nodes_to_find
+    sample_k = _num_feasible_nodes_to_find(profile, n)
+
+    def node_reason(i: int) -> Optional[str]:
+        r = _filter_node(state, i, template, profile)
+        if r is not None:
+            return r
+        if has_ports and placed_per_node[i] > 0:
+            return ("node(s) didn't have free ports for the requested "
+                    "pod ports")
+        if not verdict.mask[i]:
+            return verdict.reasons[i]
+        if verdict.self_disk_conflict and placed_per_node[i] > 0:
+            return vol_ops.REASON_DISK_CONFLICT
+        if verdict.rwop_self_conflict and placements:
+            return vol_ops.REASON_RWOP_CONFLICT
+        return None
+
     while True:
         if max_limit and len(placements) >= max_limit:
             return placements, {}
-        feasible = []
-        reasons: Dict[str, int] = {}
-        for i in range(snapshot.num_nodes):
-            r = _filter_node(state, i, template, profile)
-            if r is None:
-                # fit contributes every insufficient resource; others one
-                feasible.append(i)
+        feasible = [i for i in range(n) if node_reason(i) is None]
         if not feasible:
-            for i in range(snapshot.num_nodes):
-                r = _filter_node(state, i, template, profile)
-                if r and r.startswith("Insufficient") or r == "Too many pods":
+            reasons: Dict[str, int] = {}
+            for i in range(n):
+                r = node_reason(i)
+                if r and (r.startswith("Insufficient") or r == "Too many pods"):
                     for fr in _fit_reasons(state, i, template):
                         reasons[fr] = reasons.get(fr, 0) + 1
                 elif r:
                     reasons[r] = reasons.get(r, 0) + 1
             return placements, reasons
-        totals = _score_nodes(state, feasible, template, profile)
-        best = max(feasible, key=lambda i: (totals[i], -i))
+        scorable = feasible
+        if sample_k > 0:
+            by_rank = sorted(feasible, key=lambda i: (i - next_start) % n)
+            scorable = by_rank[:sample_k]
+            last_rank = (scorable[-1] - next_start) % n
+            next_start = (next_start + min(last_rank + 1, n)) % n
+        totals = _score_nodes(state, scorable, template, profile)
+        best = max(scorable, key=lambda i: (totals[i], -i))
         placements.append(best)
+        placed_per_node[best] += 1
         clone = ps.make_clone(template, step)
         clone["spec"]["nodeName"] = snapshot.node_names[best]
         state.pods_by_node[best].append(clone)

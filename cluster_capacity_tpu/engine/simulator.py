@@ -47,6 +47,9 @@ class StaticConfig(NamedTuple):
     deterministic: bool
     fit_filter_on: bool
     clone_has_ports: bool
+    volume_filter_on: bool
+    volume_self_conflict: bool
+    rwop_self_conflict: bool
     spread_hard_n: int
     spread_soft_n: int
     ipa_filter_on: bool
@@ -59,6 +62,24 @@ class StaticConfig(NamedTuple):
     weights: Tuple[Tuple[str, int], ...]
     fit_strategy_type: str
     fit_shape: Tuple[Tuple[float, ...], Tuple[float, ...]]
+    # 0 = score all feasible nodes; otherwise numFeasibleNodesToFind
+    # (schedule_one.go:697-725) emulated deterministically.
+    sample_k: int
+
+
+def _num_feasible_nodes_to_find(profile, num_all: int) -> int:
+    """numFeasibleNodesToFind (schedule_one.go:697-725): 0 means score-all."""
+    pct = profile.percentage_of_nodes_to_score
+    if pct >= 100 and not profile.adaptive_sampling:
+        return 0
+    if num_all < 100:                     # minFeasibleNodesToFind
+        return 0
+    if profile.adaptive_sampling and pct >= 100:
+        pct = max(5, 50 - num_all // 125)
+    num = num_all * pct // 100
+    if num < 100:
+        return 100
+    return num
 
 
 def static_config(pb: enc.EncodedProblem) -> StaticConfig:
@@ -69,6 +90,9 @@ def static_config(pb: enc.EncodedProblem) -> StaticConfig:
         deterministic=profile.deterministic,
         fit_filter_on=profile.filter_enabled("NodeResourcesFit"),
         clone_has_ports=pb.clone_has_host_ports,
+        volume_filter_on=bool(not pb.volume_mask.all()),
+        volume_self_conflict=pb.volume_self_conflict,
+        rwop_self_conflict=pb.rwop_self_conflict,
         spread_hard_n=pb.spread_hard.num_constraints,
         spread_soft_n=pb.spread_soft.num_constraints,
         ipa_filter_on=profile.filter_enabled("InterPodAffinity") and (
@@ -84,6 +108,7 @@ def static_config(pb: enc.EncodedProblem) -> StaticConfig:
         fit_strategy_type=profile.fit_strategy.type,
         fit_shape=(tuple(profile.fit_strategy.shape_utilization),
                    tuple(profile.fit_strategy.shape_score)),
+        sample_k=_num_feasible_nodes_to_find(profile, pb.snapshot.num_nodes),
     )
 
 
@@ -98,6 +123,7 @@ class Carry(NamedTuple):
     pref_dyn: "jax.Array"           # f[G, Da]
     placed_count: "jax.Array"       # i32
     stopped: "jax.Array"            # bool
+    next_start: "jax.Array"         # i32 — rotating sample start index
     rng: "jax.Array"                # PRNG key (unused when deterministic)
 
 
@@ -164,6 +190,7 @@ def build_consts(pb: enc.EncodedProblem) -> Dict[str, "jax.Array"]:
         "fit_nz": jnp.asarray(pb.fit_uses_nonzero),
         "bal_idx": jnp.asarray(pb.balanced_res_idx),
         "bal_req": f(pb.balanced_req),
+        "volume_mask": jnp.asarray(pb.volume_mask),
         "sh_dom": jnp.asarray(pb.spread_hard.node_domain),
         "sh_countable": jnp.asarray(pb.spread_hard.node_countable),
         "sh_valid": jnp.asarray(pb.spread_hard.domain_valid),
@@ -212,6 +239,7 @@ def _init_carry(pb: enc.EncodedProblem, consts, seed: int) -> Carry:
         pref_dyn=jnp.zeros((g, d), dtype=dt),
         placed_count=jnp.zeros((), dtype=jnp.int32),
         stopped=jnp.zeros((), dtype=bool),
+        next_start=jnp.zeros((), dtype=jnp.int32),
         rng=jax.random.PRNGKey(seed),
     )
 
@@ -232,6 +260,13 @@ def _feasibility(cfg: StaticConfig, consts, carry: Carry):
         ports_ok = ~(carry.placed > 0)
         parts["ports_dyn"] = ports_ok
         feasible = feasible & ports_ok
+
+    if cfg.volume_filter_on:
+        feasible = feasible & consts["volume_mask"]
+    if cfg.volume_self_conflict:
+        feasible = feasible & ~(carry.placed > 0)
+    if cfg.rwop_self_conflict:
+        feasible = feasible & (carry.placed_count == 0)
 
     if cfg.spread_hard_n > 0:
         sp_ok, sp_missing = spread_ops.hard_filter(
@@ -328,10 +363,28 @@ def _step(cfg: StaticConfig, consts, carry: Carry):
 
     feasible, _parts = _feasibility(cfg, consts, carry)
     any_feasible = jnp.any(feasible)
-    total = _scores(cfg, consts, carry, feasible)
+
+    next_start = carry.next_start
+    scorable = feasible
+    if cfg.sample_k > 0:
+        # Deterministic emulation of findNodesThatPassFilters' truncation
+        # (schedule_one.go:610-694): take the first K feasible nodes in
+        # round-robin order from the rotating start index, and advance the
+        # index past the last node examined.
+        n = feasible.shape[0]
+        idx = jnp.arange(n, dtype=jnp.int32)
+        rank = jnp.remainder(idx - carry.next_start, n)
+        feas_rank = jnp.where(feasible, rank, n)
+        kth = jnp.sort(feas_rank)[min(cfg.sample_k, feasible.shape[0]) - 1]
+        threshold = jnp.where(kth >= n, n - 1, kth)
+        scorable = feasible & (rank <= threshold)
+        processed = jnp.minimum(threshold + 1, n)
+        next_start = jnp.remainder(carry.next_start + processed, n)
+
+    total = _scores(cfg, consts, carry, scorable)
 
     neg_one = jnp.asarray(-1.0, dt)
-    keyed = jnp.where(feasible, total, neg_one)
+    keyed = jnp.where(scorable, total, neg_one)
     if cfg.deterministic:
         chosen = jnp.argmax(keyed).astype(jnp.int32)
         rng = carry.rng
@@ -389,6 +442,7 @@ def _step(cfg: StaticConfig, consts, carry: Carry):
         aff_dyn=aff_dyn, anti_dyn=anti_dyn, pref_dyn=pref_dyn,
         placed_count=carry.placed_count + place.astype(jnp.int32),
         stopped=carry.stopped | ~any_feasible,
+        next_start=jnp.where(carry.stopped, carry.next_start, next_start),
         rng=rng,
     )
     return new_carry, jnp.where(place, chosen, -1)
@@ -431,6 +485,17 @@ def solve(pb: enc.EncodedProblem, max_limit: int = 0,
                            fail_type=FAIL_UNSCHEDULABLE,
                            fail_message="0/0 nodes are available",
                            node_names=[])
+
+    if pb.pod_level_reason:
+        # PreEnqueue/PreFilter pod-level rejection: the FitError message is
+        # "0/N nodes are available: <PreFilterMsg>." (types.go:788-793).
+        n = pb.snapshot.num_nodes
+        return SolveResult(
+            placements=[], placed_count=0,
+            fail_type=pb.pod_level_fail_type,
+            fail_message=f"0/{n} nodes are available: {pb.pod_level_reason}.",
+            fail_counts={pb.pod_level_reason: n},
+            node_names=pb.snapshot.node_names)
 
     _ensure_x64(pb.profile)
     cfg = static_config(pb)
@@ -527,6 +592,17 @@ def diagnose(pb: enc.EncodedProblem, cfg: StaticConfig, consts,
                 for j, rname in enumerate(pb.snapshot.resource_names):
                     if insufficient[i, j]:
                         add(f"Insufficient {rname}")
+            continue
+        if not pb.volume_mask[i]:
+            add(pb.volume_reasons[i] or "volume conflict")
+            continue
+        if cfg.volume_self_conflict and np.asarray(carry.placed)[i] > 0:
+            from ..ops.volumes import REASON_DISK_CONFLICT
+            add(REASON_DISK_CONFLICT)
+            continue
+        if cfg.rwop_self_conflict and int(np.asarray(carry.placed_count)) > 0:
+            from ..ops.volumes import REASON_RWOP_CONFLICT
+            add(REASON_RWOP_CONFLICT)
             continue
         if spread_missing[i]:
             add(enc.STATIC_REASONS[enc.CODE_SPREAD_MISSING_LABEL])

@@ -49,8 +49,22 @@ class ClusterCapacity:
     def run(self) -> SolveResult:
         if self.snapshot is None:
             raise RuntimeError("call sync_with_objects/sync_with_client first")
-        problem = encode_problem(self.snapshot, self.pod, self.profile)
-        self._result = solve(problem, max_limit=self.max_limit)
+        import time
+
+        from .utils import metrics
+        from .utils.trace import (SPAN_SNAPSHOT, SPAN_SOLVE, default_tracer)
+        t0 = time.perf_counter()
+        with default_tracer.span(SPAN_SNAPSHOT):
+            problem = encode_problem(self.snapshot, self.pod, self.profile)
+        with default_tracer.span(SPAN_SOLVE), default_tracer.profile():
+            self._result = solve(problem, max_limit=self.max_limit)
+        reg = metrics.default_registry
+        reg.inc(metrics.SCHEDULE_ATTEMPTS, amount=self._result.placed_count,
+                result="scheduled", profile=self.profile.name)
+        if self._result.fail_type == "Unschedulable":
+            reg.inc(metrics.SCHEDULE_ATTEMPTS, result="unschedulable",
+                    profile=self.profile.name)
+        reg.observe(metrics.SCHEDULING_DURATION, time.perf_counter() - t0)
         return self._result
 
     def report(self) -> ClusterCapacityReview:

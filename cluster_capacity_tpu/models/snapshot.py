@@ -53,9 +53,12 @@ class ClusterSnapshot:
     nonzero_requested: np.ndarray          # f64[N, 2] (cpu milli, mem bytes)
     pods_by_node: List[List[dict]]         # existing (non-terminal) pods per node
     # objects synced for API parity with SyncWithClient (simulator.go:176-295);
-    # consumed by volume plugins / genpod when implemented.
+    # consumed by the volume plugins / genpod.
     services: List[dict] = field(default_factory=list)
     pvcs: List[dict] = field(default_factory=list)
+    pvs: List[dict] = field(default_factory=list)
+    csinodes: List[dict] = field(default_factory=list)
+    limit_ranges: List[dict] = field(default_factory=list)
     pdbs: List[dict] = field(default_factory=list)
     replication_controllers: List[dict] = field(default_factory=list)
     replica_sets: List[dict] = field(default_factory=list)
@@ -174,6 +177,9 @@ class ClusterSnapshot:
                    pods_by_node=pods_by_node,
                    services=list(extra_objects.get("services", ())),
                    pvcs=list(extra_objects.get("pvcs", ())),
+                   pvs=list(extra_objects.get("pvs", ())),
+                   csinodes=list(extra_objects.get("csinodes", ())),
+                   limit_ranges=list(extra_objects.get("limit_ranges", ())),
                    pdbs=list(extra_objects.get("pdbs", ())),
                    replication_controllers=list(
                        extra_objects.get("replication_controllers", ())),

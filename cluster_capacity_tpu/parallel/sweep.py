@@ -30,7 +30,9 @@ from . import mesh as mesh_lib
 
 def _batchable(pb: enc.EncodedProblem) -> bool:
     return (pb.spread_hard.empty and pb.spread_soft.empty and
-            not pb.ipa.active and not pb.clone_has_host_ports)
+            not pb.ipa.active and not pb.clone_has_host_ports and
+            pb.pod_level_reason is None and not pb.volume_self_conflict and
+            not pb.rwop_self_conflict)
 
 
 def sweep(snapshot: ClusterSnapshot, templates: Sequence[dict],

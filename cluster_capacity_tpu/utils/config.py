@@ -32,9 +32,17 @@ DEFAULT_FILTERS = [
     "NodeAffinity",
     "NodePorts",
     "NodeResourcesFit",
+    "VolumeRestrictions",
+    "NodeVolumeLimits",
+    "VolumeBinding",
+    "VolumeZone",
     "PodTopologySpread",
     "InterPodAffinity",
 ]
+
+# PreEnqueue plugins (SchedulingGates, scheduling_gates.go:49) are modeled as a
+# pod-level gate before the scan starts.
+DEFAULT_PRE_ENQUEUE = ["SchedulingGates"]
 
 ALL_SCORE_PLUGINS = list(DEFAULT_SCORE_WEIGHTS)
 
@@ -63,7 +71,12 @@ class SchedulerProfile:
         default_factory=lambda: [("cpu", 1), ("memory", 1)])
     # Parity mode: score every feasible node (reference's adaptive sampling,
     # schedule_one.go:697-725, is order-dependent; disabled for determinism).
+    # Set a percentage (or enable adaptive_sampling for the reference's
+    # `max(5, 50-N/125)` formula) to emulate the sampling deterministically:
+    # the first numFeasibleNodesToFind feasible nodes in round-robin order
+    # from a rotating start index (schedule_one.go:610-694).
     percentage_of_nodes_to_score: int = 100
+    adaptive_sampling: bool = False
     # Deterministic tie-break (lowest node index) instead of the reference's
     # reservoir sampling among score ties (schedule_one.go:894-946).
     deterministic: bool = True

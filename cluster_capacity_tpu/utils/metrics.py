@@ -1,0 +1,97 @@
+"""Metrics registry.
+
+The reference defines Prometheus vectors that are never served
+(vendor/.../scheduler/metrics/metrics.go:96-127; no listener is bound because
+cluster-capacity nils out SecureServing, pkg/utils/utils.go:127-130).  This
+module keeps the same observable names as in-process counters/histograms and
+can render them in Prometheus text exposition format on demand — strictly more
+usable than the reference (which black-holes them) with the same vocabulary.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Dict, List, Tuple
+
+
+class _Histogram:
+    __slots__ = ("buckets", "counts", "total", "count")
+
+    def __init__(self, buckets: Tuple[float, ...]):
+        self.buckets = buckets
+        self.counts = [0] * (len(buckets) + 1)
+        self.total = 0.0
+        self.count = 0
+
+    def observe(self, v: float) -> None:
+        self.total += v
+        self.count += 1
+        for i, b in enumerate(self.buckets):
+            if v <= b:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+
+_LATENCY_BUCKETS = (0.001, 0.002, 0.004, 0.008, 0.016, 0.032, 0.064, 0.128,
+                    0.256, 0.512, 1.024, 2.048, 4.096, 8.192)
+
+
+class Registry:
+    """Counter + histogram registry mirroring the scheduler metric names."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counters: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = \
+            defaultdict(float)
+        self.histograms: Dict[str, _Histogram] = {}
+
+    def inc(self, name: str, amount: float = 1.0, **labels) -> None:
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            self.counters[key] += amount
+
+    def observe(self, name: str, value: float) -> None:
+        with self._lock:
+            h = self.histograms.get(name)
+            if h is None:
+                h = self.histograms[name] = _Histogram(_LATENCY_BUCKETS)
+            h.observe(value)
+
+    def get(self, name: str, **labels) -> float:
+        return self.counters.get((name, tuple(sorted(labels.items()))), 0.0)
+
+    def render(self) -> str:
+        """Prometheus text exposition format."""
+        lines: List[str] = []
+        with self._lock:
+            for (name, labels), v in sorted(self.counters.items()):
+                label_s = ",".join(f'{k}="{val}"' for k, val in labels)
+                lines.append(f"{name}{{{label_s}}} {v:g}" if label_s
+                             else f"{name} {v:g}")
+            for name, h in sorted(self.histograms.items()):
+                acc = 0
+                for b, c in zip(h.buckets, h.counts):
+                    acc += c
+                    lines.append(f'{name}_bucket{{le="{b:g}"}} {acc}')
+                lines.append(f'{name}_bucket{{le="+Inf"}} {h.count}')
+                lines.append(f"{name}_sum {h.total:g}")
+                lines.append(f"{name}_count {h.count}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def reset(self) -> None:
+        with self._lock:
+            self.counters.clear()
+            self.histograms.clear()
+
+
+# Scheduler metric names kept from the reference vocabulary
+# (metrics.go:96-127).
+SCHEDULE_ATTEMPTS = "scheduler_schedule_attempts_total"
+SCHEDULING_DURATION = "scheduler_scheduling_attempt_duration_seconds"
+PENDING_PODS = "scheduler_pending_pods"
+FRAMEWORK_EXTENSION_POINT_DURATION = \
+    "scheduler_framework_extension_point_duration_seconds"
+
+default_registry = Registry()

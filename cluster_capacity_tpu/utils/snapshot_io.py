@@ -25,6 +25,8 @@ _KIND_TO_KEY = {
     "Pod": "pods",
     "Service": "services",
     "PersistentVolumeClaim": "pvcs",
+    "PersistentVolume": "pvs",
+    "CSINode": "csinodes",
     "PodDisruptionBudget": "pdbs",
     "ReplicationController": "replication_controllers",
     "ReplicaSet": "replica_sets",

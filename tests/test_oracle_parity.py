@@ -94,3 +94,19 @@ def test_differential_random(seed):
         f"{expected} ({[snapshot.node_names[i] for i in expected]})")
     if len(expected) < limit and expected_reasons:
         assert got.fail_counts == expected_reasons, f"seed={seed}"
+
+
+def test_differential_sampling():
+    """Deterministic percentageOfNodesToScore emulation: engine vs oracle on a
+    cluster large enough (>=100 nodes) for sampling to engage."""
+    rng = np.random.RandomState(123)
+    nodes = [build_test_node(f"n{i:03d}", int(rng.choice([1000, 2000])),
+                             int(rng.choice([2, 4])) * 1024 ** 3, 20)
+             for i in range(120)]
+    pod = default_pod(build_test_pod("target", 150, 128 * 1024 ** 2))
+    snapshot = ClusterSnapshot.from_objects(nodes)
+    profile = SchedulerProfile.parity()
+    profile.percentage_of_nodes_to_score = 40   # K = max(100, 120*40/100)=100
+    expected, _ = oracle.simulate(snapshot, pod, profile, max_limit=60)
+    got = sim.solve(enc.encode_problem(snapshot, pod, profile), max_limit=60)
+    assert got.placements == expected

@@ -1,0 +1,161 @@
+"""Volume plugin family + SchedulingGates parity tests (reference semantics
+cited in ops/volumes.py)."""
+
+from cluster_capacity_tpu import ClusterCapacity, SchedulerProfile
+from cluster_capacity_tpu.models.podspec import default_pod
+
+from helpers import build_test_node, build_test_pod
+
+
+def _run(pod, nodes, limit=0, **extra):
+    cc = ClusterCapacity(default_pod(pod), max_limit=limit,
+                         profile=SchedulerProfile.parity())
+    cc.sync_with_objects(nodes, extra.pop("pods", []), **extra)
+    return cc.run()
+
+
+def _pvc(name, sc=None, volume=None, modes=("ReadWriteOnce",),
+         storage="1Gi", ns="default"):
+    return {"metadata": {"name": name, "namespace": ns},
+            "spec": {"accessModes": list(modes),
+                     "storageClassName": sc or "",
+                     "volumeName": volume or None,
+                     "resources": {"requests": {"storage": storage}}}}
+
+
+def _pv(name, sc="", zone=None, node_affinity_hostnames=None, storage="10Gi"):
+    pv = {"metadata": {"name": name, "labels": {}},
+          "spec": {"capacity": {"storage": storage},
+                   "accessModes": ["ReadWriteOnce"],
+                   "storageClassName": sc}}
+    if zone:
+        pv["metadata"]["labels"]["topology.kubernetes.io/zone"] = zone
+    if node_affinity_hostnames:
+        pv["spec"]["nodeAffinity"] = {"required": {"nodeSelectorTerms": [{
+            "matchExpressions": [{"key": "kubernetes.io/hostname",
+                                  "operator": "In",
+                                  "values": list(node_affinity_hostnames)}]}]}}
+    return pv
+
+
+def _pod_with_claim(name, claim, cpu=100):
+    pod = build_test_pod(name, cpu, 0)
+    pod["spec"]["volumes"] = [{"name": "data",
+                               "persistentVolumeClaim": {"claimName": claim}}]
+    return pod
+
+
+def test_missing_pvc_fails_pod_level():
+    nodes = [build_test_node("n1", 1000, int(1e9), 10)]
+    res = _run(_pod_with_claim("p", "nope"), nodes)
+    assert res.placed_count == 0
+    assert res.fail_message == \
+        '0/1 nodes are available: persistentvolumeclaim "nope" not found.'
+
+
+def test_unbound_immediate_claim():
+    nodes = [build_test_node("n1", 1000, int(1e9), 10)]
+    res = _run(_pod_with_claim("p", "slow"), nodes, pvcs=[_pvc("slow")])
+    assert res.placed_count == 0
+    assert "pod has unbound immediate PersistentVolumeClaims" in res.fail_message
+
+
+def test_bound_pv_node_affinity():
+    nodes = [build_test_node(f"n{i}", 1000, int(1e9), 10,
+                             labels={"kubernetes.io/hostname": f"n{i}"})
+             for i in (1, 2)]
+    pvs = [_pv("vol1", node_affinity_hostnames=["n2"])]
+    pvcs = [_pvc("claim1", volume="vol1")]
+    res = _run(_pod_with_claim("p", "claim1"), nodes, pvcs=pvcs, pvs=pvs)
+    assert set(res.per_node_counts) == {"n2"}
+    assert res.fail_counts.get("node(s) had volume node affinity conflict") == 1
+
+
+def test_volume_zone_conflict():
+    nodes = [build_test_node("na", 1000, int(1e9), 10,
+                             labels={"topology.kubernetes.io/zone": "a"}),
+             build_test_node("nb", 1000, int(1e9), 10,
+                             labels={"topology.kubernetes.io/zone": "b"})]
+    pvs = [_pv("vol1", zone="a")]
+    pvcs = [_pvc("claim1", volume="vol1")]
+    res = _run(_pod_with_claim("p", "claim1"), nodes, pvcs=pvcs, pvs=pvs)
+    assert set(res.per_node_counts) == {"na"}
+    assert res.fail_counts.get("node(s) had no available volume zone") == 1
+
+
+def test_wait_for_first_consumer_static_provisioning():
+    nodes = [build_test_node(f"n{i}", 1000, int(1e9), 10,
+                             labels={"kubernetes.io/hostname": f"n{i}"})
+             for i in (1, 2)]
+    scs = [{"metadata": {"name": "local"},
+            "provisioner": "kubernetes.io/no-provisioner",
+            "volumeBindingMode": "WaitForFirstConsumer"}]
+    pvs = [_pv("localvol", sc="local", node_affinity_hostnames=["n1"])]
+    pvcs = [_pvc("localclaim", sc="local")]
+    res = _run(_pod_with_claim("p", "localclaim"), nodes, pvcs=pvcs, pvs=pvs,
+               storage_classes=scs, limit=1)
+    assert set(res.per_node_counts) == {"n1"}
+
+
+def test_rwop_single_clone():
+    nodes = [build_test_node("n1", 10000, int(1e10), 100)]
+    pvcs = [_pvc("exclusive", volume="vol1", modes=("ReadWriteOncePod",))]
+    pvs = [_pv("vol1")]
+    res = _run(_pod_with_claim("p", "exclusive"), nodes, pvcs=pvcs, pvs=pvs)
+    assert res.placed_count == 1
+    assert "ReadWriteOncePod access mode already in-use" in res.fail_message
+
+
+def test_rwop_in_use_by_existing_pod():
+    nodes = [build_test_node("n1", 10000, int(1e10), 100)]
+    pvcs = [_pvc("exclusive", volume="vol1", modes=("ReadWriteOncePod",))]
+    pvs = [_pv("vol1")]
+    occupant = _pod_with_claim("occupant", "exclusive")
+    occupant["spec"]["nodeName"] = "n1"
+    res = _run(_pod_with_claim("p", "exclusive"), nodes, pvcs=pvcs, pvs=pvs,
+               pods=[occupant])
+    assert res.placed_count == 0
+    assert "ReadWriteOncePod access mode already in-use" in res.fail_message
+
+
+def test_inline_disk_conflict():
+    nodes = [build_test_node("n1", 10000, int(1e10), 100),
+             build_test_node("n2", 10000, int(1e10), 100)]
+    pod = build_test_pod("p", 100, 0)
+    pod["spec"]["volumes"] = [{"name": "d", "gcePersistentDisk":
+                               {"pdName": "disk-1"}}]
+    res = _run(pod, nodes)
+    # non-read-only PD: one clone per node, then disk conflicts
+    assert res.placed_count == 2
+    assert res.fail_counts.get("node(s) had no available disk") == 2
+
+
+def test_csi_volume_limits():
+    nodes = [build_test_node("n1", 10000, int(1e10), 100)]
+    csinodes = [{"metadata": {"name": "n1"},
+                 "spec": {"drivers": [{"name": "ebs.csi.aws.com",
+                                       "allocatable": {"count": 1}}]}}]
+    pvs = [{"metadata": {"name": f"vol{i}"},
+            "spec": {"capacity": {"storage": "10Gi"},
+                     "accessModes": ["ReadWriteOnce"],
+                     "storageClassName": "ebs",
+                     "csi": {"driver": "ebs.csi.aws.com",
+                             "volumeHandle": f"h{i}"}}} for i in (1, 2)]
+    pvcs = [_pvc("c1", sc="ebs", volume="vol1"),
+            _pvc("c2", sc="ebs", volume="vol2")]
+    pod = build_test_pod("p", 100, 0)
+    pod["spec"]["volumes"] = [
+        {"name": "a", "persistentVolumeClaim": {"claimName": "c1"}},
+        {"name": "b", "persistentVolumeClaim": {"claimName": "c2"}}]
+    res = _run(pod, nodes, pvcs=pvcs, pvs=pvs, csinodes=csinodes)
+    assert res.placed_count == 0
+    assert res.fail_counts.get("node(s) exceed max volume count") == 1
+
+
+def test_scheduling_gates():
+    nodes = [build_test_node("n1", 1000, int(1e9), 10)]
+    pod = build_test_pod("gated", 100, 0)
+    pod["spec"]["schedulingGates"] = [{"name": "wait"}]
+    res = _run(pod, nodes)
+    assert res.placed_count == 0
+    assert res.fail_type == "SchedulingGated"
