@@ -1,4 +1,6 @@
 from .encode import EncodedProblem, encode_problem
+from .fast_path import solve_auto, solve_fast
 from .simulator import SolveResult, solve
 
-__all__ = ["EncodedProblem", "encode_problem", "SolveResult", "solve"]
+__all__ = ["EncodedProblem", "encode_problem", "SolveResult", "solve",
+           "solve_auto", "solve_fast"]

@@ -12,7 +12,8 @@ from __future__ import annotations
 from typing import List, Optional, Sequence
 
 from .engine.encode import encode_problem
-from .engine.simulator import SolveResult, solve
+from .engine.fast_path import solve_auto
+from .engine.simulator import SolveResult
 from .models.podspec import default_pod, load_pod_yaml, parse_pod_text, validate_pod
 from .models.snapshot import ClusterSnapshot
 from .utils.config import SchedulerProfile, load_scheduler_config
@@ -57,7 +58,7 @@ class ClusterCapacity:
         with default_tracer.span(SPAN_SNAPSHOT):
             problem = encode_problem(self.snapshot, self.pod, self.profile)
         with default_tracer.span(SPAN_SOLVE), default_tracer.profile():
-            self._result = solve(problem, max_limit=self.max_limit)
+            self._result = solve_auto(problem, max_limit=self.max_limit)
         reg = metrics.default_registry
         reg.inc(metrics.SCHEDULE_ATTEMPTS, amount=self._result.placed_count,
                 result="scheduled", profile=self.profile.name)

@@ -1,0 +1,82 @@
+"""Fast-path solver (engine/fast_path.py): the analytic sorted-prefix solve
+must produce bit-identical results to the sequential scan engine whenever it
+declares itself eligible."""
+
+import numpy as np
+import pytest
+
+from cluster_capacity_tpu import SchedulerProfile
+from cluster_capacity_tpu.engine import encode as enc
+from cluster_capacity_tpu.engine import fast_path
+from cluster_capacity_tpu.engine import simulator as sim
+from cluster_capacity_tpu.models.podspec import default_pod
+from cluster_capacity_tpu.models.snapshot import ClusterSnapshot
+
+from helpers import build_test_node, build_test_pod
+
+
+def _compare(nodes, pod, limit=0, profile=None):
+    profile = profile or SchedulerProfile.parity()
+    snapshot = ClusterSnapshot.from_objects(nodes)
+    pb = enc.encode_problem(snapshot, default_pod(pod), profile)
+    fast = fast_path.solve_fast(pb, max_limit=limit)
+    assert fast is not None, "expected fast-path eligibility"
+    slow = sim.solve(pb, max_limit=limit)
+    assert fast.placements == slow.placements
+    assert fast.placed_count == slow.placed_count
+    assert fast.fail_type == slow.fail_type
+    assert fast.fail_message == slow.fail_message
+    assert fast.fail_counts == slow.fail_counts
+    return fast
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_fast_equals_scan_random(seed):
+    rng = np.random.RandomState(seed)
+    nodes = [build_test_node(
+        f"n{i:02d}", int(rng.choice([500, 1000, 2000, 4000])),
+        int(rng.choice([1, 2, 4, 8])) * 1024 ** 3,
+        int(rng.choice([5, 10, 30])))
+        for i in range(int(rng.choice([3, 7, 12])))]
+    pod = build_test_pod("p", int(rng.choice([100, 150, 333])),
+                         int(rng.choice([64, 100, 300])) * 1024 ** 2)
+    _compare(nodes, pod, limit=int(rng.choice([0, 17])))
+
+
+def test_fast_readme_demo():
+    nodes = [build_test_node(f"kube-node-{i}", 2000, 4 * 1024 ** 3, 110)
+             for i in range(1, 5)]
+    pod = build_test_pod("small-pod", 150, 100 * 1024 ** 2)
+    fast = _compare(nodes, pod)
+    assert fast.placed_count == 52
+    assert fast.fail_message == "0/4 nodes are available: 4 Insufficient cpu."
+
+
+def test_fast_most_allocated():
+    profile = SchedulerProfile.parity()
+    profile.fit_strategy.type = "MostAllocated"
+    nodes = [build_test_node(f"n{i}", 2000, 4 * 1024 ** 3, 20)
+             for i in range(3)]
+    pod = build_test_pod("p", 300, 200 * 1024 ** 2)
+    # MostAllocated is INCREASING in k → monotonicity check must reject and
+    # fall back (solve_fast returns None).
+    snapshot = ClusterSnapshot.from_objects(nodes)
+    pb = enc.encode_problem(snapshot, default_pod(pod), profile)
+    assert fast_path.solve_fast(pb) is None
+    # solve_auto still answers, via the scan.
+    res = fast_path.solve_auto(pb)
+    assert res.placed_count > 0
+
+
+def test_fast_ineligible_with_spread():
+    nodes = [build_test_node(f"n{i}", 2000, 4 * 1024 ** 3, 20,
+                             labels={"zone": "a"}) for i in range(3)]
+    pod = build_test_pod("p", 100, 0, labels={"app": "x"})
+    pod["spec"]["topologySpreadConstraints"] = [{
+        "maxSkew": 1, "topologyKey": "zone",
+        "whenUnsatisfiable": "DoNotSchedule",
+        "labelSelector": {"matchLabels": {"app": "x"}}}]
+    snapshot = ClusterSnapshot.from_objects(nodes)
+    pb = enc.encode_problem(snapshot, default_pod(pod),
+                            SchedulerProfile.parity())
+    assert not fast_path.eligible(pb)
