@@ -1,0 +1,16 @@
+# tpu-cluster-capacity image (mirrors the reference's Dockerfile role:
+# /root/reference/Dockerfile — a single image exposing the hypercc
+# multiplexer as cluster-capacity / genpod entrypoints).
+FROM python:3.12-slim AS build
+RUN apt-get update && apt-get install -y --no-install-recommends g++ make \
+    && rm -rf /var/lib/apt/lists/*
+WORKDIR /src
+COPY . .
+RUN make native && pip install --no-cache-dir .
+
+FROM python:3.12-slim
+COPY --from=build /usr/local/lib/python3.12/site-packages /usr/local/lib/python3.12/site-packages
+COPY --from=build /usr/local/bin/cluster-capacity /usr/local/bin/genpod /usr/local/bin/hypercc /usr/local/bin/
+# the reference links hypercc to both subcommand names (cmd/hypercc/main.go:30-39)
+ENTRYPOINT ["hypercc"]
+CMD ["cluster-capacity", "--help"]

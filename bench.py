@@ -19,12 +19,36 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import time
 
 import numpy as np
 
 N_NODES = int(os.environ.get("BENCH_NODES", "10000"))
 BASELINE_PLACEMENTS_PER_SEC = 100.0
+
+
+def _probe_accelerator(timeout_s: int = 120) -> bool:
+    """Initialize the default JAX backend in a THROWAWAY subprocess first: a
+    dead TPU tunnel hangs backend init forever, and a hang inside this process
+    could not be recovered.  On probe failure the bench falls back to CPU so
+    it always emits its one JSON line."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s, capture_output=True)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def _ensure_platform() -> None:
+    if not _probe_accelerator():
+        os.environ["JAX_PLATFORM_NAME"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        sys.stderr.write("bench: accelerator probe failed; falling back to CPU\n")
 
 
 def build_problem():
@@ -57,6 +81,7 @@ def build_problem():
 
 
 def main() -> None:
+    _ensure_platform()
     from cluster_capacity_tpu.engine.fast_path import solve_auto
 
     pb = build_problem()

@@ -7,6 +7,29 @@ vmapped kernels, and run the greedy placement loop as a lax.scan.
 
 __version__ = "0.1.0"
 
+import os as _os
+
+def _apply_platform_env() -> None:
+    """Honor JAX_PLATFORMS/JAX_PLATFORM_NAME before any backend initializes.
+
+    Plugin platforms (e.g. a TPU tunnel) begin initializing during backend
+    discovery even when an env var requests cpu; restricting jax_platforms
+    before first use is the reliable off-switch and makes headless/CI runs
+    immune to a dead accelerator tunnel."""
+    # JAX_PLATFORM_NAME takes precedence: images that pin JAX_PLATFORMS
+    # globally (e.g. to a TPU plugin) still need a per-invocation override.
+    want = _os.environ.get("JAX_PLATFORM_NAME") or _os.environ.get("JAX_PLATFORMS")
+    if want:
+        import jax
+        try:
+            jax.config.update("jax_platforms", want)
+        except Exception:
+            pass
+
+_apply_platform_env()
+
+
+
 from .framework import ClusterCapacity
 from .models.snapshot import ClusterSnapshot
 from .utils.config import SchedulerProfile, load_scheduler_config

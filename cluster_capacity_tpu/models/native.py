@@ -1,0 +1,103 @@
+"""ctypes bridge to the native snapshot compiler (native/ccsnap.cpp).
+
+Build with `make native`; loading is optional — every caller falls back to the
+pure-Python aggregation when the shared library is absent.  A differential
+test (tests/test_native.py) keeps the two implementations in lockstep.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "libccsnap.so")
+_lib = None
+
+
+class _CCSnapResult(ctypes.Structure):
+    _fields_ = [
+        ("n_nodes", ctypes.c_int64),
+        ("n_resources", ctypes.c_int64),
+        ("allocatable", ctypes.POINTER(ctypes.c_double)),
+        ("requested", ctypes.POINTER(ctypes.c_double)),
+        ("nonzero", ctypes.POINTER(ctypes.c_double)),
+        ("node_names", ctypes.POINTER(ctypes.c_char)),
+        ("node_names_len", ctypes.c_int64),
+        ("resource_names", ctypes.POINTER(ctypes.c_char)),
+        ("resource_names_len", ctypes.c_int64),
+        ("error", ctypes.c_char_p),
+    ]
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_LIB_PATH):
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.ccsnap_compile.restype = ctypes.POINTER(_CCSnapResult)
+        lib.ccsnap_compile.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                       ctypes.c_char_p]
+        lib.ccsnap_free.argtypes = [ctypes.POINTER(_CCSnapResult)]
+    except OSError:
+        # wrong arch / corrupt build: behave as if not built
+        return None
+    _lib = lib
+    return lib
+
+
+@dataclass
+class CompiledArrays:
+    node_names: List[str]
+    resource_names: List[str]
+    allocatable: np.ndarray     # f64[N, R]
+    requested: np.ndarray       # f64[N, R]
+    nonzero: np.ndarray         # f64[N, 2]
+
+
+def compile_snapshot(objects: dict,
+                     exclude_nodes: Sequence[str] = ()
+                     ) -> Optional[CompiledArrays]:
+    """Aggregate node/pod resource tensors natively.  Returns None when the
+    library is unavailable (caller uses the Python path)."""
+    lib = _load()
+    if lib is None:
+        return None
+    payload = json.dumps({"nodes": objects.get("nodes") or [],
+                          "pods": objects.get("pods") or []}).encode()
+    res_p = lib.ccsnap_compile(payload, len(payload),
+                               ",".join(exclude_nodes).encode())
+    res = res_p.contents
+    try:
+        if res.error:
+            raise ValueError(res.error.decode())
+        n, r = res.n_nodes, res.n_resources
+        alloc = np.ctypeslib.as_array(res.allocatable, shape=(n * r,)) \
+            .reshape(n, r).copy() if n * r else np.zeros((n, r))
+        req = np.ctypeslib.as_array(res.requested, shape=(n * r,)) \
+            .reshape(n, r).copy() if n * r else np.zeros((n, r))
+        nz = np.ctypeslib.as_array(res.nonzero, shape=(n * 2,)) \
+            .reshape(n, 2).copy() if n else np.zeros((n, 2))
+        names_blob = ctypes.string_at(res.node_names, res.node_names_len) \
+            if res.node_names_len else b""
+        res_blob = ctypes.string_at(res.resource_names,
+                                    res.resource_names_len) \
+            if res.resource_names_len else b""
+        node_names = [s.decode() for s in names_blob.split(b"\0")[:-1]]
+        resource_names = [s.decode() for s in res_blob.split(b"\0")[:-1]]
+        return CompiledArrays(node_names=node_names,
+                              resource_names=resource_names,
+                              allocatable=alloc, requested=req, nonzero=nz)
+    finally:
+        lib.ccsnap_free(res_p)

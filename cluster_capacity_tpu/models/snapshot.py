@@ -109,6 +109,7 @@ class ClusterSnapshot:
                      pods: Sequence[Mapping] = (),
                      exclude_nodes: Sequence[str] = (),
                      sort_nodes: bool = True,
+                     use_native: Optional[bool] = None,
                      **extra_objects) -> "ClusterSnapshot":
         """Build a snapshot the way SyncWithClient does: skip excluded nodes
         (simulator.go:209), drop terminal pods (:196), pivot pods onto their
@@ -116,7 +117,11 @@ class ClusterSnapshot:
 
         Nodes are sorted by name by default for deterministic node-axis order
         (the parity-mode replacement for the reference's zone round-robin
-        node_tree ordering)."""
+        node_tree ordering).
+
+        The resource-tensor aggregation runs through the native compiler
+        (models/native.py, `make native`) when the shared library is built;
+        use_native=False forces the pure-Python path."""
         excluded = set(exclude_nodes)
         node_list = [dict(n) for n in nodes
                      if (n.get("metadata") or {}).get("name") not in excluded]
@@ -133,6 +138,33 @@ class ClusterSnapshot:
             node_name = (pod.get("spec") or {}).get("nodeName") or ""
             if node_name in index_of:
                 pods_by_node[index_of[node_name]].append(dict(pod))
+
+        if use_native is not False and sort_nodes:
+            if use_native:
+                # explicit request: propagate failures instead of falling back
+                from . import native
+                if not native.available():
+                    raise RuntimeError("use_native=True but libccsnap.so is "
+                                       "not available (run `make native`)")
+                compiled = native.compile_snapshot(
+                    {"nodes": [dict(n) for n in nodes],
+                     "pods": [dict(p) for p in pods]},
+                    exclude_nodes=exclude_nodes)
+                if compiled.node_names != names:
+                    raise RuntimeError("native snapshot compiler node-axis "
+                                       "mismatch")
+            else:
+                compiled = _try_native(nodes, pods, exclude_nodes)
+                if compiled is not None and compiled.node_names != names:
+                    compiled = None
+            if compiled is not None:
+                return cls(nodes=node_list, node_names=names,
+                           resource_names=compiled.resource_names,
+                           allocatable=compiled.allocatable,
+                           requested=compiled.requested,
+                           nonzero_requested=compiled.nonzero,
+                           pods_by_node=pods_by_node,
+                           **_extra_kwargs(extra_objects))
 
         # Resource vocabulary: base + scalars seen in allocatable or requests.
         scalars = set()
@@ -175,18 +207,27 @@ class ClusterSnapshot:
                    resource_names=resource_names, allocatable=allocatable,
                    requested=requested, nonzero_requested=nonzero,
                    pods_by_node=pods_by_node,
-                   services=list(extra_objects.get("services", ())),
-                   pvcs=list(extra_objects.get("pvcs", ())),
-                   pvs=list(extra_objects.get("pvs", ())),
-                   csinodes=list(extra_objects.get("csinodes", ())),
-                   limit_ranges=list(extra_objects.get("limit_ranges", ())),
-                   pdbs=list(extra_objects.get("pdbs", ())),
-                   replication_controllers=list(
-                       extra_objects.get("replication_controllers", ())),
-                   replica_sets=list(extra_objects.get("replica_sets", ())),
-                   stateful_sets=list(extra_objects.get("stateful_sets", ())),
-                   storage_classes=list(extra_objects.get("storage_classes", ())),
-                   namespaces=list(extra_objects.get("namespaces", ())))
+                   **_extra_kwargs(extra_objects))
+
+
+def _extra_kwargs(extra_objects: Mapping) -> dict:
+    keys = ("services", "pvcs", "pvs", "csinodes", "limit_ranges", "pdbs",
+            "replication_controllers", "replica_sets", "stateful_sets",
+            "storage_classes", "namespaces")
+    return {k: list(extra_objects.get(k, ())) for k in keys}
+
+
+def _try_native(nodes, pods, exclude_nodes):
+    from . import native
+    if not native.available():
+        return None
+    try:
+        return native.compile_snapshot(
+            {"nodes": [dict(n) for n in nodes],
+             "pods": [dict(p) for p in pods]},
+            exclude_nodes=exclude_nodes)
+    except Exception:
+        return None
 
 
 def _normalize_image(name: str) -> str:

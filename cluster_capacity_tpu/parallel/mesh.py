@@ -92,6 +92,7 @@ def carry_shardings(mesh, carry, batched: bool = False):
         pref_dyn=spec(None, None),
         placed_count=spec(),
         stopped=spec(),
+        next_start=spec(),
         rng=NamedSharding(mesh, P()) if not batched else spec(None),
     )
 

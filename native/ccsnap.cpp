@@ -1,0 +1,579 @@
+// ccsnap: native snapshot compiler for tpu-cluster-capacity.
+//
+// The host-side encode cost at large scale is dominated by walking the
+// snapshot's pod/node objects and folding resource quantities — the analog of
+// the reference's SyncWithClient copy + NodeInfo accumulation
+// (/root/reference/pkg/framework/simulator.go:176-295 and
+// vendor/.../scheduler/framework/types.go:940-1050), which the reference runs
+// in compiled Go.  This module does the same aggregation in C++ over the raw
+// snapshot JSON, emitting flat tensors through a C ABI consumed via ctypes
+// (cluster_capacity_tpu/models/native.py).
+//
+// Semantics mirrored (kept in lockstep with the Python implementation; a
+// differential test asserts equality):
+// - Quantity parsing: decimal SI (n,u,m,k,M,G,T,P,E), binary (Ki..Ei),
+//   scientific notation; CPU → ceil(milli), others → ceil(value).
+// - Pod requests: max(sum(containers), per-initContainer) with restartable
+//   (sidecar) init containers summed, + overhead
+//   (resourcehelper.PodRequests semantics).
+// - NonZeroRequested: cpu/mem defaulted to 100m / 200MB when absent.
+// - Terminal pods (Succeeded/Failed) skipped; pods pivoted by spec.nodeName.
+//
+// Build: make native  (g++ -O2 -shared -fPIC, no external deps).
+
+#include <algorithm>
+#include <cctype>
+#include <cmath>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+// ---------------------------------------------------------------------------
+// Minimal JSON value + recursive-descent parser (objects, arrays, strings,
+// numbers, bools, null; UTF-8 passthrough, \uXXXX kept verbatim-decoded to
+// bytes for label keys is unnecessary — snapshot keys are ASCII).
+// ---------------------------------------------------------------------------
+
+struct JValue;
+using JObject = std::vector<std::pair<std::string, JValue>>;
+using JArray = std::vector<JValue>;
+
+struct JValue {
+  enum Kind { NUL, BOOL, NUM, STR, ARR, OBJ } kind = NUL;
+  bool b = false;
+  double num = 0;
+  std::string str;              // also holds raw number text for quantities
+  std::shared_ptr<JArray> arr;
+  std::shared_ptr<JObject> obj;
+
+  const JValue* get(const char* key) const {
+    if (kind != OBJ || !obj) return nullptr;
+    for (auto& kv : *obj)
+      if (kv.first == key) return &kv.second;
+    return nullptr;
+  }
+  const std::string& as_str() const { return str; }
+};
+
+struct Parser {
+  const char* p;
+  const char* end;
+  bool ok = true;
+
+  explicit Parser(const char* data, size_t len) : p(data), end(data + len) {}
+
+  void skip_ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r'))
+      ++p;
+  }
+  bool eat(char c) {
+    skip_ws();
+    if (p < end && *p == c) { ++p; return true; }
+    return false;
+  }
+
+  JValue parse() {
+    skip_ws();
+    if (p >= end) { ok = false; return {}; }
+    switch (*p) {
+      case '{': return parse_obj();
+      case '[': return parse_arr();
+      case '"': return parse_str();
+      case 't': case 'f': return parse_bool();
+      case 'n': p += 4 <= end - p ? 4 : end - p; return {};
+      default:  return parse_num();
+    }
+  }
+
+  JValue parse_obj() {
+    JValue v; v.kind = JValue::OBJ; v.obj = std::make_shared<JObject>();
+    ++p;  // '{'
+    skip_ws();
+    if (eat('}')) return v;
+    while (ok) {
+      skip_ws();
+      JValue key = parse_str();
+      if (!eat(':')) { ok = false; break; }
+      JValue val = parse();
+      v.obj->emplace_back(std::move(key.str), std::move(val));
+      if (eat(',')) continue;
+      if (eat('}')) break;
+      ok = false; break;
+    }
+    return v;
+  }
+
+  JValue parse_arr() {
+    JValue v; v.kind = JValue::ARR; v.arr = std::make_shared<JArray>();
+    ++p;  // '['
+    skip_ws();
+    if (eat(']')) return v;
+    while (ok) {
+      v.arr->push_back(parse());
+      if (eat(',')) continue;
+      if (eat(']')) break;
+      ok = false; break;
+    }
+    return v;
+  }
+
+  JValue parse_str() {
+    JValue v; v.kind = JValue::STR;
+    if (p >= end || *p != '"') { ok = false; return v; }
+    ++p;
+    while (p < end && *p != '"') {
+      if (*p == '\\' && p + 1 < end) {
+        ++p;
+        switch (*p) {
+          case 'n': v.str += '\n'; break;
+          case 't': v.str += '\t'; break;
+          case 'r': v.str += '\r'; break;
+          case 'b': v.str += '\b'; break;
+          case 'f': v.str += '\f'; break;
+          case 'u': {
+            if (p + 4 < end) {
+              unsigned code = 0;
+              for (int i = 1; i <= 4; ++i) {
+                code <<= 4;
+                char c = p[i];
+                code |= (c >= '0' && c <= '9') ? c - '0'
+                        : (c >= 'a' && c <= 'f') ? c - 'a' + 10
+                        : (c >= 'A' && c <= 'F') ? c - 'A' + 10 : 0;
+              }
+              // encode UTF-8 (BMP only; surrogate pairs unhandled — snapshot
+              // identifiers are DNS-1123 names)
+              if (code < 0x80) v.str += static_cast<char>(code);
+              else if (code < 0x800) {
+                v.str += static_cast<char>(0xC0 | (code >> 6));
+                v.str += static_cast<char>(0x80 | (code & 0x3F));
+              } else {
+                v.str += static_cast<char>(0xE0 | (code >> 12));
+                v.str += static_cast<char>(0x80 | ((code >> 6) & 0x3F));
+                v.str += static_cast<char>(0x80 | (code & 0x3F));
+              }
+              p += 4;
+            }
+            break;
+          }
+          default: v.str += *p;
+        }
+      } else {
+        v.str += *p;
+      }
+      ++p;
+    }
+    if (p < end) ++p;  // closing quote
+    else ok = false;
+    return v;
+  }
+
+  JValue parse_bool() {
+    JValue v; v.kind = JValue::BOOL;
+    if (end - p >= 4 && std::strncmp(p, "true", 4) == 0) { v.b = true; p += 4; }
+    else if (end - p >= 5 && std::strncmp(p, "false", 5) == 0) { p += 5; }
+    else ok = false;
+    return v;
+  }
+
+  JValue parse_num() {
+    JValue v; v.kind = JValue::NUM;
+    const char* start = p;
+    while (p < end && (std::isdigit(static_cast<unsigned char>(*p)) ||
+                       *p == '-' || *p == '+' || *p == '.' || *p == 'e' ||
+                       *p == 'E'))
+      ++p;
+    v.str.assign(start, p - start);
+    v.num = std::strtod(v.str.c_str(), nullptr);
+    return v;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// Quantity parsing (vendor/k8s.io/apimachinery resource.Quantity subset).
+// Values returned as long double "units"; cpu uses milli-units.
+// ---------------------------------------------------------------------------
+
+static bool parse_quantity(const std::string& s, long double* out) {
+  if (s.empty()) return false;
+  size_t i = 0;
+  int sign = 1;
+  if (s[i] == '+' || s[i] == '-') {
+    sign = s[i] == '-' ? -1 : 1;
+    ++i;
+  }
+  size_t num_start = i;
+  while (i < s.size() && (std::isdigit(static_cast<unsigned char>(s[i])) ||
+                          s[i] == '.'))
+    ++i;
+  if (i == num_start) return false;
+  long double base = strtold(s.substr(num_start, i - num_start).c_str(),
+                             nullptr);
+  long double mult = 1.0L;
+  std::string suffix = s.substr(i);
+  if (suffix.empty()) mult = 1.0L;
+  else if (suffix == "n") mult = 1e-9L;
+  else if (suffix == "u") mult = 1e-6L;
+  else if (suffix == "m") mult = 1e-3L;
+  else if (suffix == "k") mult = 1e3L;
+  else if (suffix == "M") mult = 1e6L;
+  else if (suffix == "G") mult = 1e9L;
+  else if (suffix == "T") mult = 1e12L;
+  else if (suffix == "P") mult = 1e15L;
+  else if (suffix == "E") mult = 1e18L;
+  else if (suffix == "Ki") mult = 1024.0L;
+  else if (suffix == "Mi") mult = 1048576.0L;
+  else if (suffix == "Gi") mult = 1073741824.0L;
+  else if (suffix == "Ti") mult = 1099511627776.0L;
+  else if (suffix == "Pi") mult = 1125899906842624.0L;
+  else if (suffix == "Ei") mult = 1152921504606846976.0L;
+  else if (suffix[0] == 'e' || suffix[0] == 'E')
+    mult = powl(10.0L, strtold(suffix.c_str() + 1, nullptr));
+  else return false;
+  *out = sign * base * mult;
+  return true;
+}
+
+// Set false when any quantity fails to parse; compile() then reports an
+// error instead of silently zeroing tensors (matching the Python path's
+// QuantityError behavior).
+static thread_local bool g_quantities_ok = true;
+
+static int64_t quantity_value(const JValue* q, bool milli) {
+  if (!q) return 0;
+  long double v = 0;
+  if (q->kind == JValue::STR) {
+    if (!parse_quantity(q->str, &v)) {
+      g_quantities_ok = false;
+      return 0;
+    }
+  } else if (q->kind == JValue::NUM) {
+    v = static_cast<long double>(q->num);
+  } else {
+    return 0;
+  }
+  if (milli) v *= 1000.0L;
+  return static_cast<int64_t>(ceill(v));
+}
+
+// ---------------------------------------------------------------------------
+// Pod request folding (resourcehelper.PodRequests semantics).
+// ---------------------------------------------------------------------------
+
+using ResMap = std::map<std::string, int64_t>;
+
+static const int64_t kDefaultMilliCPU = 100;             // pod_resources.go:29
+static const int64_t kDefaultMemory = 200LL * 1024 * 1024;  // :31
+
+static void container_requests(const JValue& c, ResMap* out) {
+  const JValue* res = c.get("resources");
+  const JValue* reqs = res ? res->get("requests") : nullptr;
+  if (!reqs || reqs->kind != JValue::OBJ) return;
+  for (auto& kv : *reqs->obj) {
+    bool milli = kv.first == "cpu";
+    (*out)[kv.first] += quantity_value(&kv.second, milli);
+  }
+}
+
+static void map_add(ResMap* a, const ResMap& b) {
+  for (auto& kv : b) (*a)[kv.first] += kv.second;
+}
+
+static void map_max(ResMap* a, const ResMap& b) {
+  for (auto& kv : b) {
+    auto it = a->find(kv.first);
+    if (it == a->end() || it->second < kv.second) (*a)[kv.first] = kv.second;
+  }
+}
+
+static ResMap pod_requests(const JValue& pod) {
+  ResMap reqs;
+  const JValue* spec = pod.get("spec");
+  if (!spec) return reqs;
+  if (const JValue* cs = spec->get("containers")) {
+    if (cs->kind == JValue::ARR)
+      for (auto& c : *cs->arr) {
+        ResMap r;
+        container_requests(c, &r);
+        map_add(&reqs, r);
+      }
+  }
+  ResMap init_reqs, restartable_sum;
+  if (const JValue* ics = spec->get("initContainers")) {
+    if (ics->kind == JValue::ARR)
+      for (auto& c : *ics->arr) {
+        ResMap r;
+        container_requests(c, &r);
+        const JValue* rp = c.get("restartPolicy");
+        if (rp && rp->str == "Always") {
+          map_add(&reqs, r);
+          map_add(&restartable_sum, r);
+          r = restartable_sum;
+        } else {
+          map_add(&r, restartable_sum);
+        }
+        map_max(&init_reqs, r);
+      }
+  }
+  map_max(&reqs, init_reqs);
+  if (const JValue* oh = spec->get("overhead")) {
+    if (oh->kind == JValue::OBJ)
+      for (auto& kv : *oh->obj)
+        reqs[kv.first] += quantity_value(&kv.second, kv.first == "cpu");
+  }
+  return reqs;
+}
+
+static void pod_nonzero(const JValue& pod, int64_t* cpu, int64_t* mem) {
+  // GetNonzeroRequests: per-container defaults for missing cpu/mem, with the
+  // same sum/max folding as pod_requests.
+  *cpu = 0;
+  *mem = 0;
+  ResMap reqs;
+  const JValue* spec = pod.get("spec");
+  if (!spec) { *cpu = kDefaultMilliCPU; *mem = kDefaultMemory; return; }
+  auto with_defaults = [](const JValue& c) {
+    ResMap r;
+    container_requests(c, &r);
+    if (r.find("cpu") == r.end()) r["cpu"] = kDefaultMilliCPU;
+    if (r.find("memory") == r.end()) r["memory"] = kDefaultMemory;
+    return r;
+  };
+  if (const JValue* cs = spec->get("containers")) {
+    if (cs->kind == JValue::ARR)
+      for (auto& c : *cs->arr) map_add(&reqs, with_defaults(c));
+  }
+  ResMap init_reqs, restartable_sum;
+  if (const JValue* ics = spec->get("initContainers")) {
+    if (ics->kind == JValue::ARR)
+      for (auto& c : *ics->arr) {
+        ResMap r = with_defaults(c);
+        const JValue* rp = c.get("restartPolicy");
+        if (rp && rp->str == "Always") {
+          map_add(&reqs, r);
+          map_add(&restartable_sum, r);
+          r = restartable_sum;
+        } else {
+          map_add(&r, restartable_sum);
+        }
+        map_max(&init_reqs, r);
+      }
+  }
+  map_max(&reqs, init_reqs);
+  if (const JValue* oh = spec->get("overhead")) {
+    if (oh->kind == JValue::OBJ)
+      for (auto& kv : *oh->obj)
+        reqs[kv.first] += quantity_value(&kv.second, kv.first == "cpu");
+  }
+  // pod with no containers at all: GetNonzeroRequests still defaults
+  auto itc = reqs.find("cpu");
+  auto itm = reqs.find("memory");
+  *cpu = itc == reqs.end() ? kDefaultMilliCPU : itc->second;
+  *mem = itm == reqs.end() ? kDefaultMemory : itm->second;
+}
+
+// ---------------------------------------------------------------------------
+// C ABI
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+struct CCSnapResult {
+  int64_t n_nodes;
+  int64_t n_resources;
+  double* allocatable;     // [n_nodes * n_resources]
+  double* requested;       // [n_nodes * n_resources]
+  double* nonzero;         // [n_nodes * 2]
+  char* node_names;        // NUL-joined
+  int64_t node_names_len;
+  char* resource_names;    // NUL-joined
+  int64_t resource_names_len;
+  char* error;             // non-NULL on failure
+};
+
+static char* dup_cstr(const std::string& s) {
+  char* out = static_cast<char*>(std::malloc(s.size() + 1));
+  std::memcpy(out, s.data(), s.size() + 1);
+  return out;
+}
+
+// Compile a snapshot JSON ({"nodes": [...], "pods": [...], ...}) into flat
+// resource tensors.  Node order: sorted by name (matching
+// ClusterSnapshot.from_objects); resource axis: pods/cpu/memory/
+// ephemeral-storage + sorted scalars.
+CCSnapResult* ccsnap_compile(const char* data, int64_t len,
+                             const char* exclude_csv) {
+  auto* res = new CCSnapResult();
+  std::memset(res, 0, sizeof(CCSnapResult));
+  g_quantities_ok = true;
+
+  Parser parser(data, static_cast<size_t>(len));
+  JValue root = parser.parse();
+  if (!parser.ok || root.kind != JValue::OBJ) {
+    res->error = dup_cstr("ccsnap: invalid JSON snapshot");
+    return res;
+  }
+
+  std::vector<std::string> excluded;
+  if (exclude_csv && *exclude_csv) {
+    std::string csv(exclude_csv);
+    size_t pos = 0;
+    while (pos != std::string::npos) {
+      size_t comma = csv.find(',', pos);
+      excluded.push_back(csv.substr(pos, comma == std::string::npos
+                                             ? comma : comma - pos));
+      pos = comma == std::string::npos ? comma : comma + 1;
+    }
+  }
+  auto is_excluded = [&](const std::string& name) {
+    for (auto& e : excluded)
+      if (e == name) return true;
+    return false;
+  };
+
+  const JValue* nodes = root.get("nodes");
+  const JValue* pods = root.get("pods");
+
+  struct NodeEntry {
+    std::string name;
+    const JValue* node;
+  };
+  std::vector<NodeEntry> node_list;
+  if (nodes && nodes->kind == JValue::ARR) {
+    for (auto& nv : *nodes->arr) {
+      const JValue* meta = nv.get("metadata");
+      const JValue* name = meta ? meta->get("name") : nullptr;
+      std::string nm = name ? name->str : "";
+      if (is_excluded(nm)) continue;
+      node_list.push_back({nm, &nv});
+    }
+  }
+  std::sort(node_list.begin(), node_list.end(),
+            [](const NodeEntry& a, const NodeEntry& b) {
+              return a.name < b.name;
+            });
+  std::map<std::string, int64_t> node_index;
+  for (size_t i = 0; i < node_list.size(); ++i)
+    node_index[node_list[i].name] = static_cast<int64_t>(i);
+
+  // Gather per-node allocatable maps + pod aggregates.
+  std::vector<ResMap> alloc_maps(node_list.size());
+  std::vector<ResMap> req_maps(node_list.size());
+  std::vector<int64_t> pod_counts(node_list.size(), 0);
+  std::vector<int64_t> nz_cpu(node_list.size(), 0), nz_mem(node_list.size(), 0);
+
+  for (size_t i = 0; i < node_list.size(); ++i) {
+    const JValue* status = node_list[i].node->get("status");
+    const JValue* alloc = status ? status->get("allocatable") : nullptr;
+    if (alloc && alloc->kind == JValue::OBJ)
+      for (auto& kv : *alloc->obj)
+        alloc_maps[i][kv.first] = quantity_value(&kv.second, kv.first == "cpu");
+  }
+
+  if (pods && pods->kind == JValue::ARR) {
+    for (auto& pv : *pods->arr) {
+      const JValue* status = pv.get("status");
+      const JValue* phase = status ? status->get("phase") : nullptr;
+      if (phase && (phase->str == "Succeeded" || phase->str == "Failed"))
+        continue;
+      const JValue* spec = pv.get("spec");
+      const JValue* node_name = spec ? spec->get("nodeName") : nullptr;
+      if (!node_name || node_name->str.empty()) continue;
+      auto it = node_index.find(node_name->str);
+      if (it == node_index.end()) continue;
+      int64_t idx = it->second;
+      map_add(&req_maps[idx], pod_requests(pv));
+      pod_counts[idx] += 1;
+      int64_t c, m;
+      pod_nonzero(pv, &c, &m);
+      nz_cpu[idx] += c;
+      nz_mem[idx] += m;
+    }
+  }
+
+  // Resource vocabulary: base 4 + sorted scalars (domain-prefixed or
+  // hugepages-/attachable-volumes-; mirrors is_scalar_resource_name).
+  auto is_scalar = [](const std::string& r) {
+    if (r.rfind("hugepages-", 0) == 0 ||
+        r.rfind("attachable-volumes-", 0) == 0)
+      return true;
+    if (r == "cpu" || r == "memory" || r == "ephemeral-storage" ||
+        r == "pods" || r == "storage")
+      return false;
+    if (r.rfind("requests.", 0) == 0) return false;
+    return r.find('/') != std::string::npos;
+  };
+  std::map<std::string, int64_t> scalar_set;
+  for (auto& m : alloc_maps)
+    for (auto& kv : m)
+      if (is_scalar(kv.first)) scalar_set[kv.first] = 0;
+  for (auto& m : req_maps)
+    for (auto& kv : m)
+      if (is_scalar(kv.first)) scalar_set[kv.first] = 0;
+
+  std::vector<std::string> resource_names = {"pods", "cpu", "memory",
+                                             "ephemeral-storage"};
+  for (auto& kv : scalar_set) resource_names.push_back(kv.first);
+  std::map<std::string, int64_t> r_index;
+  for (size_t j = 0; j < resource_names.size(); ++j)
+    r_index[resource_names[j]] = static_cast<int64_t>(j);
+
+  int64_t n = static_cast<int64_t>(node_list.size());
+  int64_t r = static_cast<int64_t>(resource_names.size());
+  res->n_nodes = n;
+  res->n_resources = r;
+  res->allocatable = static_cast<double*>(std::calloc(n * r, sizeof(double)));
+  res->requested = static_cast<double*>(std::calloc(n * r, sizeof(double)));
+  res->nonzero = static_cast<double*>(std::calloc(n * 2, sizeof(double)));
+
+  for (int64_t i = 0; i < n; ++i) {
+    for (auto& kv : alloc_maps[i]) {
+      auto it = r_index.find(kv.first);
+      if (it != r_index.end())
+        res->allocatable[i * r + it->second] = static_cast<double>(kv.second);
+    }
+    for (auto& kv : req_maps[i]) {
+      auto it = r_index.find(kv.first);
+      if (it != r_index.end())
+        res->requested[i * r + it->second] = static_cast<double>(kv.second);
+    }
+    res->requested[i * r + 0] = static_cast<double>(pod_counts[i]);
+    res->nonzero[i * 2 + 0] = static_cast<double>(nz_cpu[i]);
+    res->nonzero[i * 2 + 1] = static_cast<double>(nz_mem[i]);
+  }
+
+  std::string names_blob, res_blob;
+  for (auto& ne : node_list) {
+    names_blob += ne.name;
+    names_blob += '\0';
+  }
+  for (auto& rn : resource_names) {
+    res_blob += rn;
+    res_blob += '\0';
+  }
+  res->node_names = static_cast<char*>(std::malloc(names_blob.size()));
+  std::memcpy(res->node_names, names_blob.data(), names_blob.size());
+  res->node_names_len = static_cast<int64_t>(names_blob.size());
+  res->resource_names = static_cast<char*>(std::malloc(res_blob.size()));
+  std::memcpy(res->resource_names, res_blob.data(), res_blob.size());
+  res->resource_names_len = static_cast<int64_t>(res_blob.size());
+  if (!g_quantities_ok)
+    res->error = dup_cstr("ccsnap: unparseable resource quantity in snapshot");
+  return res;
+}
+
+void ccsnap_free(CCSnapResult* res) {
+  if (!res) return;
+  std::free(res->allocatable);
+  std::free(res->requested);
+  std::free(res->nonzero);
+  std::free(res->node_names);
+  std::free(res->resource_names);
+  std::free(res->error);
+  delete res;
+}
+
+}  // extern "C"

@@ -16,3 +16,13 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+# Build the native snapshot compiler when the toolchain is present so the
+# native differential tests run by default (they skip when it is absent).
+import shutil  # noqa: E402
+import subprocess  # noqa: E402
+
+_repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_lib = os.path.join(_repo, "cluster_capacity_tpu", "models", "libccsnap.so")
+if not os.path.exists(_lib) and shutil.which("g++") and shutil.which("make"):
+    subprocess.run(["make", "native"], cwd=_repo, capture_output=True)

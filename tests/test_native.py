@@ -1,0 +1,93 @@
+"""Differential test: the native snapshot compiler (native/ccsnap.cpp) must
+produce exactly the same resource tensors as the pure-Python aggregation."""
+
+import numpy as np
+import pytest
+
+from cluster_capacity_tpu.models import native
+from cluster_capacity_tpu.models.snapshot import ClusterSnapshot
+
+from helpers import build_test_node, build_test_pod
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="libccsnap.so not built (make native)")
+
+
+def _random_objects(seed: int, n_nodes: int = 40):
+    rng = np.random.RandomState(seed)
+    nodes, pods = [], []
+    for i in range(n_nodes):
+        extra = {}
+        if rng.rand() < 0.3:
+            extra["nvidia.com/gpu"] = str(int(rng.randint(1, 9)))
+        if rng.rand() < 0.2:
+            extra["hugepages-2Mi"] = "1Gi"
+        nodes.append(build_test_node(
+            f"n{i:03d}", int(rng.choice([1000, 2000, 7777])),
+            int(rng.choice([1, 2, 8])) * 1024 ** 3,
+            int(rng.choice([10, 110])), extra_alloc=extra))
+        for k in range(int(rng.randint(4))):
+            pod = build_test_pod(f"p-{i}-{k}",
+                                 int(rng.choice([-1, 0, 100, 333])),
+                                 int(rng.choice([-1, 0, 100 * 1024 ** 2])),
+                                 node_name=f"n{i:03d}")
+            if rng.rand() < 0.3:
+                pod["spec"]["initContainers"] = [{
+                    "name": "init",
+                    "resources": {"requests": {"cpu": "500m",
+                                               "memory": "256Mi"}}}]
+            if rng.rand() < 0.2:
+                pod["spec"]["initContainers"] = [{
+                    "name": "sidecar", "restartPolicy": "Always",
+                    "resources": {"requests": {"cpu": "50m"}}}]
+            if rng.rand() < 0.2:
+                pod["spec"]["overhead"] = {"cpu": "10m", "memory": "16Mi"}
+            if rng.rand() < 0.15:
+                pod["status"] = {"phase": str(rng.choice(
+                    ["Succeeded", "Failed", "Running"]))}
+            if rng.rand() < 0.2:
+                pod["spec"]["containers"][0]["resources"]["requests"][
+                    "nvidia.com/gpu"] = "1"
+            pods.append(pod)
+    return nodes, pods
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_native_matches_python(seed):
+    nodes, pods = _random_objects(seed)
+    py = ClusterSnapshot.from_objects(nodes, pods, use_native=False)
+    nat = ClusterSnapshot.from_objects(nodes, pods, use_native=True)
+    assert nat.node_names == py.node_names
+    assert nat.resource_names == py.resource_names
+    np.testing.assert_array_equal(nat.allocatable, py.allocatable)
+    np.testing.assert_array_equal(nat.requested, py.requested)
+    np.testing.assert_array_equal(nat.nonzero_requested, py.nonzero_requested)
+
+
+def test_native_exclude_nodes():
+    nodes, pods = _random_objects(99, n_nodes=10)
+    py = ClusterSnapshot.from_objects(nodes, pods, use_native=False,
+                                      exclude_nodes=["n003", "n007"])
+    nat = ClusterSnapshot.from_objects(nodes, pods, use_native=True,
+                                       exclude_nodes=["n003", "n007"])
+    assert nat.node_names == py.node_names
+    np.testing.assert_array_equal(nat.allocatable, py.allocatable)
+    np.testing.assert_array_equal(nat.requested, py.requested)
+
+
+def test_native_quantity_forms():
+    """Exercise quantity suffix corners through both paths."""
+    node = {"metadata": {"name": "n1"}, "spec": {},
+            "status": {"allocatable": {
+                "cpu": "1500m", "memory": "1.5Gi", "pods": "1e2",
+                "ephemeral-storage": "100G", "nvidia.com/gpu": "2"}}}
+    pod = {"metadata": {"name": "p", "namespace": "default"},
+           "spec": {"nodeName": "n1", "containers": [{
+               "name": "c", "resources": {"requests": {
+                   "cpu": "0.3", "memory": "100M",
+                   "nvidia.com/gpu": "1"}}}]}}
+    py = ClusterSnapshot.from_objects([node], [pod], use_native=False)
+    nat = ClusterSnapshot.from_objects([node], [pod], use_native=True)
+    np.testing.assert_array_equal(nat.allocatable, py.allocatable)
+    np.testing.assert_array_equal(nat.requested, py.requested)
+    np.testing.assert_array_equal(nat.nonzero_requested, py.nonzero_requested)
