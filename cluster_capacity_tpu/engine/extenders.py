@@ -1,0 +1,188 @@
+"""Scheduler extenders: the HTTP webhook escape hatch.
+
+Reference semantics (/root/reference/vendor/k8s.io/kubernetes/pkg/scheduler/extender.go):
+extenders are called sequentially after the in-tree filters with the feasible
+node set (schedule_one.go:725-773) and during prioritization
+(schedule_one.go:819-877); extender priorities are weighted and ADDED to the
+plugin score sum (no normalization).
+
+Because a webhook call per cycle breaks batching (SURVEY.md §7.10), extender
+mode runs a host-driven loop: the jitted kernels still compute all masks and
+scores on device in one shot per cycle, the host calls the extenders with the
+feasible node list, applies their verdicts, picks the argmax, and commits the
+placement through the jitted apply step.  Extenders are configured from the
+KubeSchedulerConfiguration `extenders:` section or injected as Python
+callables (tests / embedding).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from . import encode as enc
+from . import simulator as sim
+
+
+@dataclass
+class ExtenderConfig:
+    """One extender (KubeSchedulerConfiguration .extenders[] subset)."""
+
+    url_prefix: str = ""
+    filter_verb: str = ""
+    prioritize_verb: str = ""
+    weight: int = 1
+    node_cache_capable: bool = False
+    ignorable: bool = False
+    http_timeout_s: float = 30.0
+    # test/embedding hooks: take (pod, node_names) → same payloads as HTTP
+    filter_callable: Optional[Callable] = None
+    prioritize_callable: Optional[Callable] = None
+
+    def filter(self, pod: dict, node_names: List[str]) -> Dict:
+        if self.filter_callable is not None:
+            return self.filter_callable(pod, node_names) or {}
+        if not self.filter_verb:
+            return {}
+        return self._post(self.filter_verb, pod, node_names)
+
+    def prioritize(self, pod: dict, node_names: List[str]) -> List[Dict]:
+        if self.prioritize_callable is not None:
+            return self.prioritize_callable(pod, node_names) or []
+        if not self.prioritize_verb:
+            return []
+        out = self._post(self.prioritize_verb, pod, node_names)
+        return out if isinstance(out, list) else []
+
+    def _post(self, verb: str, pod: dict, node_names: List[str]):
+        args = {"Pod": pod, "NodeNames": node_names}
+        req = urllib.request.Request(
+            self.url_prefix.rstrip("/") + "/" + verb,
+            data=json.dumps(args).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=self.http_timeout_s) as r:
+            return json.loads(r.read().decode())
+
+
+def parse_extenders(cfg: dict) -> List[ExtenderConfig]:
+    """Parse the `extenders:` section of a KubeSchedulerConfiguration."""
+    out = []
+    for e in cfg.get("extenders") or []:
+        out.append(ExtenderConfig(
+            url_prefix=e.get("urlPrefix", ""),
+            filter_verb=e.get("filterVerb", ""),
+            prioritize_verb=e.get("prioritizeVerb", ""),
+            weight=int(e.get("weight", 1)),
+            node_cache_capable=bool(e.get("nodeCacheCapable")),
+            ignorable=bool(e.get("ignorable")),
+            http_timeout_s=_parse_duration(e.get("httpTimeout")),
+        ))
+    return out
+
+
+def _parse_duration(v) -> float:
+    if v is None:
+        return 30.0
+    if isinstance(v, (int, float)):
+        return float(v)
+    s = str(v)
+    if s.endswith("ms"):
+        return float(s[:-2]) / 1000.0
+    if s.endswith("s"):
+        return float(s[:-1])
+    return 30.0
+
+
+def solve_with_extenders(pb: enc.EncodedProblem,
+                         extenders: Sequence[ExtenderConfig],
+                         max_limit: int = 0) -> sim.SolveResult:
+    """Host-driven greedy loop with extender calls each cycle."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    if pb.snapshot.num_nodes == 0 or pb.pod_level_reason:
+        return sim.solve(pb, max_limit=max_limit)
+
+    sim._ensure_x64(pb.profile)
+    cfg = sim.static_config(pb)
+    consts = sim.build_consts(pb)
+    carry = sim._init_carry(pb, consts, pb.profile.seed)
+    names = pb.snapshot.node_names
+    name_to_idx = {n: i for i, n in enumerate(names)}
+
+    @functools.partial(jax.jit, static_argnames=("cfg",))
+    def compute(cfg, consts, carry):
+        feasible, _ = sim._feasibility(cfg, consts, carry)
+        total = sim._scores(cfg, consts, carry, feasible)
+        return feasible, total
+
+    @functools.partial(jax.jit, static_argnames=("cfg",))
+    def apply(cfg, consts, carry, chosen):
+        place = jnp.asarray(True)
+        return sim._apply_placement(cfg, consts, carry, chosen, place)
+
+    budget = pb.max_steps_hint + 1
+    if max_limit and max_limit > 0:
+        budget = min(max_limit, budget)
+    budget = max(1, min(budget, sim._DEFAULT_UNLIMITED_CAP))
+
+    placements: List[int] = []
+    while len(placements) < budget:
+        feasible, total = compute(cfg, consts, carry)
+        feasible = np.asarray(feasible).copy()
+        total = np.asarray(total, dtype=np.float64).copy()
+        if not feasible.any():
+            break
+
+        feasible_names = [names[i] for i in np.flatnonzero(feasible)]
+        for ext in extenders:
+            try:
+                if ext.filter_verb or ext.filter_callable:
+                    verdict = ext.filter(pb.pod, feasible_names)
+                    if verdict.get("Error"):
+                        raise RuntimeError(verdict["Error"])
+                    kept = verdict.get("NodeNames")
+                    if kept is not None:
+                        keep = set(kept)
+                        for nm in list(feasible_names):
+                            if nm not in keep:
+                                feasible[name_to_idx[nm]] = False
+                        feasible_names = [n for n in feasible_names
+                                          if n in keep]
+                if ext.prioritize_verb or ext.prioritize_callable:
+                    for hp in ext.prioritize(pb.pod, feasible_names):
+                        nm = hp.get("Host")
+                        if nm in name_to_idx:
+                            total[name_to_idx[nm]] += \
+                                ext.weight * float(hp.get("Score", 0))
+            except Exception:
+                if not ext.ignorable:
+                    raise
+        if not feasible.any():
+            break
+
+        # -inf sentinel: extender scores may push totals negative
+        keyed = np.where(feasible, total, -np.inf)
+        chosen = int(np.argmax(keyed))     # first max → lowest index ties
+        carry = apply(cfg, consts, carry, jnp.asarray(chosen, jnp.int32))
+        placements.append(chosen)
+
+    placed = len(placements)
+    if max_limit and placed >= max_limit:
+        return sim.SolveResult(
+            placements=placements, placed_count=placed,
+            fail_type=sim.FAIL_LIMIT_REACHED,
+            fail_message=f"Maximum number of pods simulated: {max_limit}",
+            node_names=names)
+    counts = sim.diagnose(pb, cfg, consts, carry)
+    msg = sim.format_fit_error(pb.snapshot.num_nodes, counts)
+    return sim.SolveResult(
+        placements=placements, placed_count=placed,
+        fail_type=sim.FAIL_UNSCHEDULABLE, fail_message=msg,
+        fail_counts=counts, node_names=names)

@@ -1,0 +1,241 @@
+"""DefaultPreemption (PostFilter): dry-run victim selection + node choice.
+
+Reference semantics (/root/reference/vendor/k8s.io/kubernetes/pkg/scheduler/):
+- framework/plugins/defaultpreemption/default_preemption.go:132 — PostFilter
+  delegates to the preemption evaluator.
+- framework/preemption/preemption.go:234 (Evaluate), :741 (DryRunPreemption),
+  :624 (pickOneNodeForPreemption).  Victim selection per node: remove every
+  lower-priority pod, verify the incoming pod fits, then reprieve victims
+  (highest priority first, PDB-violating pods last) while the pod still fits.
+  Node choice criteria, in order: fewest PDB violations → lowest
+  highest-victim priority → smallest priority sum → fewest victims → latest
+  highest-priority-victim start time → first in node order.
+- Preemption messages in the pod condition: "preemption: 0/N nodes are
+  available: X Preemption is not helpful for scheduling, Y No preemption
+  victims found for incoming pod."
+
+Here preemption runs host-side between tensorized solve rounds: it is the rare
+path (only pods with priority above some existing pod reach it), operates on
+object state, and each successful preemption re-encodes the snapshot and
+resumes the batched solve (framework.py run loop).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+from . import oracle
+from ..models import podspec as ps
+from ..models.labels import match_label_selector
+from ..models.snapshot import ClusterSnapshot
+from ..utils.config import SchedulerProfile
+
+MSG_NOT_HELPFUL = "Preemption is not helpful for scheduling"
+MSG_NO_VICTIMS = "No preemption victims found for incoming pod"
+
+# Failure reasons that preemption cannot resolve (the plugin returned
+# UnschedulableAndUnresolvable — removing pods can't change them).
+_UNRESOLVABLE_REASONS = (
+    "node(s) were unschedulable",
+    "node(s) didn't match the requested node name",
+    "node(s) had untolerated taint",
+    "node(s) didn't match Pod's node affinity/selector",
+    "node(s) didn't match pod topology spread constraints (missing required label)",
+    "node(s) didn't match pod affinity rules",
+    "node(s) had volume node affinity conflict",
+    "node(s) didn't find available persistent volumes to bind",
+    "node(s) had no available volume zone",
+)
+
+
+def resolve_priority(pod: Mapping, priority_classes: Sequence[Mapping]) -> int:
+    """Pod priority: spec.priority, else priorityClassName lookup, else the
+    globalDefault class, else 0."""
+    spec = pod.get("spec") or {}
+    if spec.get("priority") is not None:
+        return int(spec["priority"])
+    name = spec.get("priorityClassName")
+    default = 0
+    for pc in priority_classes:
+        if (pc.get("metadata") or {}).get("name") == name:
+            return int(pc.get("value", 0))
+        if pc.get("globalDefault"):
+            default = int(pc.get("value", 0))
+    return default
+
+
+@dataclass
+class PreemptionOutcome:
+    node_index: Optional[int]          # chosen node, None when preemption failed
+    victims: List[dict]                # pods to delete (on the chosen node)
+    # per-node postfilter message histogram for the failure message
+    message_counts: Dict[str, int]
+
+    @property
+    def succeeded(self) -> bool:
+        return self.node_index is not None
+
+
+def _is_unresolvable(reason: Optional[str]) -> bool:
+    if reason is None:
+        return False
+    return any(reason.startswith(r) for r in _UNRESOLVABLE_REASONS)
+
+
+def _pdb_disruptions_allowed(snapshot: ClusterSnapshot) -> List[Tuple[dict, int]]:
+    out = []
+    for pdb in snapshot.pdbs:
+        allowed = ((pdb.get("status") or {}).get("disruptionsAllowed"))
+        out.append((pdb, int(allowed) if allowed is not None else 0))
+    return out
+
+
+def _split_pdb_violations(pods: List[dict], pdbs: List[Tuple[dict, int]]
+                          ) -> Tuple[List[dict], List[dict]]:
+    """filterPodsWithPDBViolation: walk the pod set consuming each PDB's
+    shared disruption budget; a pod is 'violating' when a matching PDB's
+    budget is already exhausted at its turn.  Returns (violating, ok)."""
+    remaining = {id(p): allowed for p, allowed in pdbs}
+    violating, ok = [], []
+    for v in pods:
+        v_ns = (v.get("metadata") or {}).get("namespace") or "default"
+        v_labels = (v.get("metadata") or {}).get("labels") or {}
+        violates = False
+        matched = []
+        for pdb, _allowed in pdbs:
+            if ((pdb.get("metadata") or {}).get("namespace") or "default") != v_ns:
+                continue
+            selector = (pdb.get("spec") or {}).get("selector")
+            if not match_label_selector(selector, v_labels):
+                continue
+            matched.append(pdb)
+            if remaining[id(pdb)] <= 0:
+                violates = True
+        for pdb in matched:
+            remaining[id(pdb)] -= 1
+        (violating if violates else ok).append(v)
+    return violating, ok
+
+
+def _pdb_violations(victims: List[dict], pdbs: List[Tuple[dict, int]]) -> int:
+    return len(_split_pdb_violations(victims, pdbs)[0])
+
+
+def _pod_start_time(pod: Mapping) -> str:
+    return ((pod.get("status") or {}).get("startTime")) or \
+        ((pod.get("metadata") or {}).get("creationTimestamp")) or ""
+
+
+def evaluate(snapshot: ClusterSnapshot, state_pods: List[List[dict]],
+             pod: Mapping, profile: SchedulerProfile,
+             node_ok=None) -> PreemptionOutcome:
+    """Run the preemption dry-run over every candidate node.
+
+    `state_pods` is the CURRENT per-node pod roster (snapshot pods + clones
+    placed so far); victims are only selected among pods with lower priority
+    than the incoming pod.  `node_ok(node_name) -> bool` lets the caller veto
+    candidates the in-tree filters can't see (extender-filtered nodes)."""
+    incoming_priority = resolve_priority(pod, snapshot.priority_classes)
+    if ((pod.get("spec") or {}).get("preemptionPolicy")) == "Never":
+        return PreemptionOutcome(None, [], {
+            MSG_NOT_HELPFUL: snapshot.num_nodes})
+
+    state = oracle.OracleState(snapshot)
+    state.pods_by_node = [list(p) for p in state_pods]
+    pdbs = _pdb_disruptions_allowed(snapshot)
+
+    candidates = []                     # (node_idx, victims, pdb_violations)
+    message_counts: Dict[str, int] = {}
+
+    def add_msg(m: str):
+        message_counts[m] = message_counts.get(m, 0) + 1
+
+    for i in range(snapshot.num_nodes):
+        reason = oracle._filter_node(state, i, pod, profile)
+        if reason is None:
+            # feasible without preemption — callers only invoke this after an
+            # infeasible cycle, but guard anyway
+            continue
+        if _is_unresolvable(reason):
+            add_msg(MSG_NOT_HELPFUL)
+            continue
+        if node_ok is not None and not node_ok(snapshot.node_names[i]):
+            add_msg(MSG_NOT_HELPFUL)
+            continue
+
+        lower = [p for p in state.pods_by_node[i]
+                 if resolve_priority(p, snapshot.priority_classes)
+                 < incoming_priority]
+        if not lower:
+            add_msg(MSG_NO_VICTIMS)
+            continue
+
+        # Dry run: remove all lower-priority pods, check fit.
+        saved = state.pods_by_node[i]
+        state.pods_by_node[i] = [p for p in saved if p not in lower]
+        if oracle._filter_node(state, i, pod, profile) is not None:
+            state.pods_by_node[i] = saved
+            add_msg(MSG_NOT_HELPFUL)
+            continue
+
+        # Reprieve: add back highest-priority victims first while the pod
+        # still fits; PDB-violating pods are reprieved last (preemption.go
+        # :624 sorts violating pods after non-violating).
+        def sort_key(p):
+            return (-resolve_priority(p, snapshot.priority_classes),
+                    _pod_start_time(p))
+        violating, ok_pods = _split_pdb_violations(lower, pdbs)
+        victims: List[dict] = []
+        for p in sorted(violating, key=sort_key) + sorted(ok_pods, key=sort_key):
+            state.pods_by_node[i] = state.pods_by_node[i] + [p]
+            if oracle._filter_node(state, i, pod, profile) is not None:
+                # cannot reprieve: p stays a victim
+                state.pods_by_node[i] = state.pods_by_node[i][:-1]
+                victims.append(p)
+        state.pods_by_node[i] = saved
+        candidates.append((i, victims, _pdb_violations(victims, pdbs)))
+
+    if not candidates:
+        return PreemptionOutcome(None, [], message_counts)
+
+    # pickOneNodeForPreemption (preemption.go:624): explicit tournament so
+    # the "latest start time wins" criterion compares strings descending
+    # (ISO-8601 timestamps order lexicographically).
+    def stats(c):
+        i, victims, pdb_viol = c
+        priorities = sorted((resolve_priority(p, snapshot.priority_classes)
+                             for p in victims), reverse=True)
+        highest = priorities[0] if priorities else -(2 ** 31)
+        latest_start = max((_pod_start_time(p) for p in victims
+                            if resolve_priority(p, snapshot.priority_classes)
+                            == highest), default="")
+        return (pdb_viol, highest, sum(priorities), len(victims),
+                latest_start, i)
+
+    def better(a, b) -> bool:
+        """True when candidate-stats a beats b."""
+        for field_idx in (0, 1, 2, 3):          # all: smaller wins
+            if a[field_idx] != b[field_idx]:
+                return a[field_idx] < b[field_idx]
+        if a[4] != b[4]:                        # latest start time wins
+            return a[4] > b[4]
+        return a[5] < b[5]                      # first in node order
+
+    best = candidates[0]
+    best_stats = stats(best)
+    for c in candidates[1:]:
+        c_stats = stats(c)
+        if better(c_stats, best_stats):
+            best, best_stats = c, c_stats
+    return PreemptionOutcome(best[0], best[1], message_counts)
+
+
+def format_preemption_message(num_nodes: int,
+                              counts: Dict[str, int]) -> str:
+    """'preemption: 0/N nodes are available: <sorted counts>.'"""
+    reasons = sorted(f"{v} {k}" for k, v in counts.items())
+    msg = f"preemption: 0/{num_nodes} nodes are available"
+    if reasons:
+        msg += ": " + ", ".join(reasons) + "."
+    return msg

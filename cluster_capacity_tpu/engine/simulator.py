@@ -397,6 +397,22 @@ def _step(cfg: StaticConfig, consts, carry: Carry):
         chosen = jnp.argmax(keyed + 0.5 * jitter.astype(dt)).astype(jnp.int32)
 
     place = any_feasible & ~carry.stopped
+    new_carry = _apply_placement(cfg, consts, carry, chosen, place, next_start,
+                                 rng)
+    new_carry = new_carry._replace(stopped=carry.stopped | ~any_feasible)
+    return new_carry, jnp.where(place, chosen, -1)
+
+
+def _apply_placement(cfg: StaticConfig, consts, carry: Carry, chosen,
+                     place, next_start=None, rng=None) -> Carry:
+    """Commit one placement into the carry (the binder-plugin analog —
+    plugin.go:34-53 sets NodeName+Running; here it is a scatter update)."""
+    import jax.numpy as jnp
+    dt = _dt(cfg)
+    if next_start is None:
+        next_start = carry.next_start
+    if rng is None:
+        rng = carry.rng
     gate = place.astype(dt)
 
     requested = carry.requested.at[chosen].add(gate * consts["req_vec"])
@@ -436,16 +452,15 @@ def _step(cfg: StaticConfig, consts, carry: Carry):
             consts["ipa_self_pref"], chosen, weight=consts["ipa_pref_w"])
         pref_dyn = jnp.where(place, upd, carry.pref_dyn)
 
-    new_carry = Carry(
+    return Carry(
         requested=requested, nonzero=nonzero, placed=placed,
         spread_hard=spread_hard, spread_soft=spread_soft,
         aff_dyn=aff_dyn, anti_dyn=anti_dyn, pref_dyn=pref_dyn,
         placed_count=carry.placed_count + place.astype(jnp.int32),
-        stopped=carry.stopped | ~any_feasible,
+        stopped=carry.stopped,
         next_start=jnp.where(carry.stopped, carry.next_start, next_start),
         rng=rng,
     )
-    return new_carry, jnp.where(place, chosen, -1)
 
 
 @functools.lru_cache(maxsize=None)

@@ -55,10 +55,8 @@ class ClusterCapacity:
         from .utils import metrics
         from .utils.trace import (SPAN_SNAPSHOT, SPAN_SOLVE, default_tracer)
         t0 = time.perf_counter()
-        with default_tracer.span(SPAN_SNAPSHOT):
-            problem = encode_problem(self.snapshot, self.pod, self.profile)
         with default_tracer.span(SPAN_SOLVE), default_tracer.profile():
-            self._result = solve_auto(problem, max_limit=self.max_limit)
+            self._result = self._solve_with_preemption(default_tracer)
         reg = metrics.default_registry
         reg.inc(metrics.SCHEDULE_ATTEMPTS, amount=self._result.placed_count,
                 result="scheduled", profile=self.profile.name)
@@ -67,6 +65,103 @@ class ClusterCapacity:
                     profile=self.profile.name)
         reg.observe(metrics.SCHEDULING_DURATION, time.perf_counter() - t0)
         return self._result
+
+    def _solve_with_preemption(self, tracer) -> SolveResult:
+        """Batched solve + the DefaultPreemption PostFilter loop: when a cycle
+        ends Unschedulable and victims exist, evict them and resume
+        (engine/preemption.py; preemption.go:234)."""
+        from .engine.preemption import evaluate, format_preemption_message
+        from .models.podspec import make_clone
+        from .utils.trace import SPAN_SNAPSHOT
+
+        snapshot = self.snapshot
+        profile = self.profile
+        preempt_on = "DefaultPreemption" in profile.post_filters
+
+        working_pods: List[dict] = [p for plist in snapshot.pods_by_node
+                                    for p in plist]
+        placements: List[int] = []
+        clone_seq = 0
+        result: Optional[SolveResult] = None
+
+        while True:
+            with tracer.span(SPAN_SNAPSHOT):
+                snap = snapshot if not placements and \
+                    len(working_pods) == sum(len(p) for p in
+                                             snapshot.pods_by_node) \
+                    else ClusterSnapshot.from_objects(
+                        snapshot.nodes, working_pods, sort_nodes=True,
+                        **{k: getattr(snapshot, k) for k in (
+                            "services", "pvcs", "pvs", "csinodes",
+                            "limit_ranges", "priority_classes", "pdbs",
+                            "replication_controllers", "replica_sets",
+                            "stateful_sets", "storage_classes", "namespaces")})
+                problem = encode_problem(snap, self.pod, profile)
+            remaining = (self.max_limit - len(placements)) \
+                if self.max_limit else 0
+            if self.max_limit and remaining <= 0:
+                break
+            if profile.extenders:
+                from .engine.extenders import solve_with_extenders
+                result = solve_with_extenders(problem, profile.extenders,
+                                              max_limit=remaining)
+            else:
+                result = solve_auto(problem, max_limit=remaining)
+            placements.extend(result.placements)
+            if result.fail_type != "Unschedulable" or not preempt_on:
+                break
+
+            state_pods = [list(p) for p in snap.pods_by_node]
+            for j, idx in enumerate(result.placements):
+                clone = make_clone(self.pod, clone_seq + j)
+                clone["spec"]["nodeName"] = snap.node_names[idx]
+                state_pods[idx].append(clone)
+            node_ok = None
+            if profile.extenders:
+                # veto candidates the extender webhooks reject — the in-tree
+                # dry run can't see them (preemption.go consults supporting
+                # extenders during victim selection)
+                def node_ok(name, _prof=profile):
+                    for ext in _prof.extenders:
+                        if not (ext.filter_verb or ext.filter_callable):
+                            continue
+                        try:
+                            verdict = ext.filter(self.pod, [name])
+                        except Exception:
+                            if ext.ignorable:
+                                continue
+                            return False
+                        kept = verdict.get("NodeNames")
+                        if kept is not None and name not in kept:
+                            return False
+                    return True
+            outcome = evaluate(snap, state_pods, self.pod, profile,
+                               node_ok=node_ok)
+            if not outcome.succeeded:
+                if profile.include_preemption_message and outcome.message_counts:
+                    result.fail_message += " " + format_preemption_message(
+                        snap.num_nodes, outcome.message_counts)
+                break
+            # evict victims and resume; clones placed so far become pods
+            victim_ids = {id(v) for v in outcome.victims}
+            working_pods = [p for plist in snap.pods_by_node for p in plist
+                            if id(p) not in victim_ids]
+            for idx in result.placements:
+                clone = make_clone(self.pod, clone_seq)
+                clone_seq += 1
+                clone["spec"]["nodeName"] = snap.node_names[idx]
+                working_pods.append(clone)
+
+        if result is None:
+            result = solve_auto(encode_problem(snapshot, self.pod, profile),
+                                max_limit=self.max_limit)
+        if self.max_limit and len(placements) >= self.max_limit:
+            result.fail_type = "LimitReached"
+            result.fail_message = (f"Maximum number of pods simulated: "
+                                   f"{self.max_limit}")
+        result.placements = placements
+        result.placed_count = len(placements)
+        return result
 
     def report(self) -> ClusterCapacityReview:
         if self._result is None:

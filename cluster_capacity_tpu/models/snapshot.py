@@ -59,6 +59,7 @@ class ClusterSnapshot:
     pvs: List[dict] = field(default_factory=list)
     csinodes: List[dict] = field(default_factory=list)
     limit_ranges: List[dict] = field(default_factory=list)
+    priority_classes: List[dict] = field(default_factory=list)
     pdbs: List[dict] = field(default_factory=list)
     replication_controllers: List[dict] = field(default_factory=list)
     replica_sets: List[dict] = field(default_factory=list)
@@ -139,6 +140,9 @@ class ClusterSnapshot:
             if node_name in index_of:
                 pods_by_node[index_of[node_name]].append(dict(pod))
 
+        if use_native and not sort_nodes:
+            raise ValueError("use_native=True requires sort_nodes=True "
+                             "(the native compiler emits a sorted node axis)")
         if use_native is not False and sort_nodes:
             if use_native:
                 # explicit request: propagate failures instead of falling back
@@ -213,7 +217,7 @@ class ClusterSnapshot:
 def _extra_kwargs(extra_objects: Mapping) -> dict:
     keys = ("services", "pvcs", "pvs", "csinodes", "limit_ranges", "pdbs",
             "replication_controllers", "replica_sets", "stateful_sets",
-            "storage_classes", "namespaces")
+            "storage_classes", "namespaces", "priority_classes")
     return {k: list(extra_objects.get(k, ())) for k in keys}
 
 

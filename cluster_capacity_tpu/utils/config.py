@@ -77,6 +77,18 @@ class SchedulerProfile:
     # from a rotating start index (schedule_one.go:610-694).
     percentage_of_nodes_to_score: int = 100
     adaptive_sampling: bool = False
+    # PostFilter plugins (DefaultPreemption enabled by default,
+    # default_plugins.go:47): when a cycle ends Unschedulable, lower-priority
+    # victims may be evicted and the solve resumes.
+    post_filters: List[str] = field(
+        default_factory=lambda: ["DefaultPreemption"])
+    # Append the reference's "preemption: 0/N nodes are available: ..."
+    # clause to the failure message (off by default: the clause text varies
+    # across kube versions and the reports stay cleaner without it).
+    include_preemption_message: bool = False
+    # Scheduler extenders (HTTP webhooks or injected callables); when set the
+    # solve runs the host-driven extender loop (engine/extenders.py).
+    extenders: List = field(default_factory=list)
     # Deterministic tie-break (lowest node index) instead of the reference's
     # reservoir sampling among score ties (schedule_one.go:894-946).
     deterministic: bool = True
@@ -132,6 +144,7 @@ def load_scheduler_config(path: str) -> SchedulerProfile:
         return out
 
     prof.filters = apply("filter", DEFAULT_FILTERS)
+    prof.post_filters = apply("postFilter", ["DefaultPreemption"])
     score_names = apply("score", list(DEFAULT_SCORE_WEIGHTS))
     weights = {}
     for name in score_names:
@@ -167,4 +180,7 @@ def load_scheduler_config(path: str) -> SchedulerProfile:
     pct = p0.get("percentageOfNodesToScore") or cfg.get("percentageOfNodesToScore")
     if pct:
         prof.percentage_of_nodes_to_score = int(pct)
+    if cfg.get("extenders"):
+        from ..engine.extenders import parse_extenders
+        prof.extenders = parse_extenders(cfg)
     return prof

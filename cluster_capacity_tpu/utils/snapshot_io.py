@@ -34,6 +34,7 @@ _KIND_TO_KEY = {
     "StorageClass": "storage_classes",
     "Namespace": "namespaces",
     "LimitRange": "limit_ranges",
+    "PriorityClass": "priority_classes",
 }
 
 SNAPSHOT_KEYS = list(_KIND_TO_KEY.values())

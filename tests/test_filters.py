@@ -157,3 +157,36 @@ def test_topology_spread_missing_label():
     assert res.fail_counts.get(
         "node(s) didn't match pod topology spread constraints "
         "(missing required label)") == 1
+
+
+def test_extender_filter_and_prioritize():
+    """Extender webhook semantics via injected callables
+    (engine/extenders.py; extender.go + schedule_one.go:725-773,819-877)."""
+    from cluster_capacity_tpu.engine.extenders import ExtenderConfig
+
+    nodes = [build_test_node(f"n{i}", 10000, int(1e10), 100) for i in (1, 2, 3)]
+    pod = build_test_pod("p", 100, 0)
+
+    calls = {"filter": 0, "prioritize": 0}
+
+    def ext_filter(pod_obj, node_names):
+        calls["filter"] += 1
+        return {"NodeNames": [n for n in node_names if n != "n2"]}
+
+    def ext_prioritize(pod_obj, node_names):
+        calls["prioritize"] += 1
+        return [{"Host": "n3", "Score": 10}]
+
+    profile = SchedulerProfile.parity()
+    profile.extenders = [ExtenderConfig(filter_callable=ext_filter,
+                                        prioritize_callable=ext_prioritize,
+                                        weight=100)]
+    from cluster_capacity_tpu import ClusterCapacity
+    from cluster_capacity_tpu.models.podspec import default_pod
+    cc = ClusterCapacity(default_pod(pod), max_limit=4, profile=profile)
+    cc.sync_with_objects(nodes)
+    res = cc.run()
+    assert res.placed_count == 4
+    assert "n2" not in res.per_node_counts          # extender filtered
+    assert res.per_node_counts.get("n3", 0) >= 3    # extender priority wins
+    assert calls["filter"] == 4 and calls["prioritize"] == 4
