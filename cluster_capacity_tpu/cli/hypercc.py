@@ -23,6 +23,10 @@ _COMMANDS = {
 
 def run(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] in ("--version", "version"):
+        from ..utils.version import get
+        print(f"hypercc {get()}")
+        return 0
     base = os.path.basename(sys.argv[0]) if sys.argv else "hypercc"
     if base in _COMMANDS:
         return _COMMANDS[base](argv, prog=base)

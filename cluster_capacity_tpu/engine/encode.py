@@ -202,8 +202,13 @@ def encode_problem(snapshot: ClusterSnapshot, pod: dict,
             snapshot, {"metadata": pod.get("metadata", {}), "spec": {}},
             "DoNotSchedule")
     if profile.score_weight("PodTopologySpread"):
-        spread_soft = pod_topology_spread.encode_constraints(
-            snapshot, pod, "ScheduleAnyway")
+        if (pod.get("spec") or {}).get("topologySpreadConstraints"):
+            spread_soft = pod_topology_spread.encode_constraints(
+                snapshot, pod, "ScheduleAnyway")
+        else:
+            # system default spreading via service/RC/RS/SS selectors
+            spread_soft = pod_topology_spread.encode_system_default(
+                snapshot, pod)
     else:
         spread_soft = pod_topology_spread.encode_constraints(
             snapshot, {"metadata": pod.get("metadata", {}), "spec": {}},

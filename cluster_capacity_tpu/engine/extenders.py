@@ -43,12 +43,13 @@ class ExtenderConfig:
     filter_callable: Optional[Callable] = None
     prioritize_callable: Optional[Callable] = None
 
-    def filter(self, pod: dict, node_names: List[str]) -> Dict:
+    def filter(self, pod: dict, node_names: List[str],
+               node_objects: Optional[Dict[str, dict]] = None) -> Dict:
         if self.filter_callable is not None:
             return self.filter_callable(pod, node_names) or {}
         if not self.filter_verb:
             return {}
-        return self._post(self.filter_verb, pod, node_names)
+        return self._post(self.filter_verb, pod, node_names, node_objects)
 
     def prioritize(self, pod: dict, node_names: List[str]) -> List[Dict]:
         if self.prioritize_callable is not None:
@@ -58,8 +59,16 @@ class ExtenderConfig:
         out = self._post(self.prioritize_verb, pod, node_names)
         return out if isinstance(out, list) else []
 
-    def _post(self, verb: str, pod: dict, node_names: List[str]):
-        args = {"Pod": pod, "NodeNames": node_names}
+    def _post(self, verb: str, pod: dict, node_names: List[str],
+              node_objects: Optional[Dict[str, dict]] = None):
+        # protocol (vendor/k8s.io/kube-scheduler/extender/v1/types.go):
+        # cache-capable extenders exchange NodeNames; others full Node lists.
+        if self.node_cache_capable or node_objects is None:
+            args = {"Pod": pod, "NodeNames": node_names}
+        else:
+            args = {"Pod": pod,
+                    "Nodes": {"items": [node_objects[n] for n in node_names
+                                        if n in node_objects]}}
         req = urllib.request.Request(
             self.url_prefix.rstrip("/") + "/" + verb,
             data=json.dumps(args).encode(),
@@ -85,16 +94,59 @@ def parse_extenders(cfg: dict) -> List[ExtenderConfig]:
 
 
 def _parse_duration(v) -> float:
+    """metav1.Duration subset: ms / s / m / h."""
     if v is None:
         return 30.0
     if isinstance(v, (int, float)):
         return float(v)
     s = str(v)
-    if s.endswith("ms"):
-        return float(s[:-2]) / 1000.0
-    if s.endswith("s"):
-        return float(s[:-1])
-    return 30.0
+    try:
+        if s.endswith("ms"):
+            return float(s[:-2]) / 1000.0
+        if s.endswith("h"):
+            return float(s[:-1]) * 3600.0
+        if s.endswith("m"):
+            return float(s[:-1]) * 60.0
+        if s.endswith("s"):
+            return float(s[:-1])
+        return float(s)
+    except ValueError:
+        return 30.0
+
+
+def _kept_names(verdict: Dict) -> Optional[List[str]]:
+    """Accept both response shapes: NodeNames (cache-capable) or Nodes.items
+    (full objects)."""
+    kept = verdict.get("NodeNames")
+    if kept is not None:
+        return list(kept)
+    nodes = verdict.get("Nodes")
+    if nodes is not None:
+        return [((n.get("metadata") or {}).get("name", ""))
+                for n in (nodes.get("items") or [])]
+    return None
+
+
+def run_filter_chain(extenders, pod: dict, node_names: List[str],
+                     node_objects: Optional[Dict[str, dict]] = None
+                     ) -> List[str]:
+    """Apply every extender's Filter sequentially; returns surviving names."""
+    names = list(node_names)
+    for ext in extenders:
+        if not (ext.filter_verb or ext.filter_callable):
+            continue
+        try:
+            verdict = ext.filter(pod, names, node_objects)
+            if verdict.get("Error"):
+                raise RuntimeError(verdict["Error"])
+            kept = _kept_names(verdict)
+            if kept is not None:
+                keep = set(kept)
+                names = [n for n in names if n in keep]
+        except Exception:
+            if not ext.ignorable:
+                raise
+    return names
 
 
 def solve_with_extenders(pb: enc.EncodedProblem,
@@ -115,6 +167,7 @@ def solve_with_extenders(pb: enc.EncodedProblem,
     carry = sim._init_carry(pb, consts, pb.profile.seed)
     names = pb.snapshot.node_names
     name_to_idx = {n: i for i, n in enumerate(names)}
+    node_objs = {n: o for n, o in zip(names, pb.snapshot.nodes)}
 
     @functools.partial(jax.jit, static_argnames=("cfg",))
     def compute(cfg, consts, carry):
@@ -141,26 +194,23 @@ def solve_with_extenders(pb: enc.EncodedProblem,
             break
 
         feasible_names = [names[i] for i in np.flatnonzero(feasible)]
+        surviving = run_filter_chain(extenders, pb.pod, feasible_names,
+                                     node_objs)
+        if len(surviving) != len(feasible_names):
+            keep = set(surviving)
+            for nm in feasible_names:
+                if nm not in keep:
+                    feasible[name_to_idx[nm]] = False
+            feasible_names = surviving
         for ext in extenders:
+            if not (ext.prioritize_verb or ext.prioritize_callable):
+                continue
             try:
-                if ext.filter_verb or ext.filter_callable:
-                    verdict = ext.filter(pb.pod, feasible_names)
-                    if verdict.get("Error"):
-                        raise RuntimeError(verdict["Error"])
-                    kept = verdict.get("NodeNames")
-                    if kept is not None:
-                        keep = set(kept)
-                        for nm in list(feasible_names):
-                            if nm not in keep:
-                                feasible[name_to_idx[nm]] = False
-                        feasible_names = [n for n in feasible_names
-                                          if n in keep]
-                if ext.prioritize_verb or ext.prioritize_callable:
-                    for hp in ext.prioritize(pb.pod, feasible_names):
-                        nm = hp.get("Host")
-                        if nm in name_to_idx:
-                            total[name_to_idx[nm]] += \
-                                ext.weight * float(hp.get("Score", 0))
+                for hp in ext.prioritize(pb.pod, feasible_names):
+                    nm = hp.get("Host")
+                    if nm in name_to_idx:
+                        total[name_to_idx[nm]] += \
+                            ext.weight * float(hp.get("Score", 0))
             except Exception:
                 if not ext.ignorable:
                     raise

@@ -179,9 +179,9 @@ def evaluate(snapshot: ClusterSnapshot, state_pods: List[List[dict]],
             add_msg(MSG_NOT_HELPFUL)
             continue
 
-        # Reprieve: add back highest-priority victims first while the pod
-        # still fits; PDB-violating pods are reprieved last (preemption.go
-        # :624 sorts violating pods after non-violating).
+        # Reprieve: try to add victims back while the pod still fits —
+        # PDB-violating pods get reprieve attempts FIRST, then the rest in
+        # priority order (preemption.go selectVictimsOnNode).
         def sort_key(p):
             return (-resolve_priority(p, snapshot.priority_classes),
                     _pod_start_time(p))

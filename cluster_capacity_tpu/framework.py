@@ -15,6 +15,7 @@ from .engine.encode import encode_problem
 from .engine.fast_path import solve_auto
 from .engine.simulator import SolveResult
 from .models.podspec import default_pod, load_pod_yaml, parse_pod_text, validate_pod
+from .models import snapshot as snapshot_mod
 from .models.snapshot import ClusterSnapshot
 from .utils.config import SchedulerProfile, load_scheduler_config
 from .utils.report import ClusterCapacityReview, build_review, print_review
@@ -36,9 +37,14 @@ class ClusterCapacity:
     def sync_with_objects(self, nodes: Sequence[dict],
                           pods: Sequence[dict] = (), **extra) -> None:
         """SyncWithClient equivalent (simulator.go:176-295) over already-fetched
-        objects; `extra` takes services/pvcs/pdbs/… keyword lists."""
+        objects; `extra` takes services/pvcs/pdbs/… keyword lists plus
+        from_objects options (node_order, sort_nodes, use_native)."""
+        self._snapshot_options = {
+            k: extra.pop(k) for k in ("node_order", "sort_nodes", "use_native")
+            if k in extra}
         self.snapshot = ClusterSnapshot.from_objects(
-            nodes, pods, exclude_nodes=self.exclude_nodes, **extra)
+            nodes, pods, exclude_nodes=self.exclude_nodes,
+            **self._snapshot_options, **extra)
 
     def sync_with_client(self, client) -> None:
         """SyncWithClient over a live kubernetes.client-compatible API object
@@ -90,12 +96,10 @@ class ClusterCapacity:
                     len(working_pods) == sum(len(p) for p in
                                              snapshot.pods_by_node) \
                     else ClusterSnapshot.from_objects(
-                        snapshot.nodes, working_pods, sort_nodes=True,
-                        **{k: getattr(snapshot, k) for k in (
-                            "services", "pvcs", "pvs", "csinodes",
-                            "limit_ranges", "priority_classes", "pdbs",
-                            "replication_controllers", "replica_sets",
-                            "stateful_sets", "storage_classes", "namespaces")})
+                        snapshot.nodes, working_pods,
+                        **getattr(self, "_snapshot_options", {}),
+                        **{k: getattr(snapshot, k)
+                           for k in snapshot_mod.OBJECT_FIELDS})
                 problem = encode_problem(snap, self.pod, profile)
             remaining = (self.max_limit - len(placements)) \
                 if self.max_limit else 0
@@ -118,25 +122,29 @@ class ClusterCapacity:
                 state_pods[idx].append(clone)
             node_ok = None
             if profile.extenders:
-                # veto candidates the extender webhooks reject — the in-tree
-                # dry run can't see them (preemption.go consults supporting
-                # extenders during victim selection)
-                def node_ok(name, _prof=profile):
-                    for ext in _prof.extenders:
-                        if not (ext.filter_verb or ext.filter_callable):
-                            continue
-                        try:
-                            verdict = ext.filter(self.pod, [name])
-                        except Exception:
-                            if ext.ignorable:
-                                continue
-                            return False
-                        kept = verdict.get("NodeNames")
-                        if kept is not None and name not in kept:
-                            return False
-                    return True
+                # veto candidates the extender webhooks reject — one batched
+                # filter call per extender per round (preemption.go consults
+                # supporting extenders during victim selection)
+                from .engine.extenders import run_filter_chain
+                passing = run_filter_chain(profile.extenders, self.pod,
+                                           list(snap.node_names),
+                                           {n: o for n, o in
+                                            zip(snap.node_names, snap.nodes)})
+                def node_ok(name, _passing=frozenset(passing)):
+                    return name in _passing
             outcome = evaluate(snap, state_pods, self.pod, profile,
                                node_ok=node_ok)
+            from .utils.events import (REASON_FAILED_SCHEDULING,
+                                       REASON_PREEMPTED, default_recorder)
+            default_recorder.eventf(
+                (self.pod.get("metadata") or {}).get("name", ""),
+                REASON_FAILED_SCHEDULING, result.fail_message)
+            for v in outcome.victims:
+                default_recorder.eventf(
+                    (v.get("metadata") or {}).get("name", ""),
+                    REASON_PREEMPTED,
+                    f"Preempted by pod on node "
+                    f"{snap.node_names[outcome.node_index]}")
             if not outcome.succeeded:
                 if profile.include_preemption_message and outcome.message_counts:
                     result.fail_message += " " + format_preemption_message(

@@ -33,6 +33,13 @@ N_BASE_RESOURCES = 4
 
 _TERMINAL_PHASES = ("Succeeded", "Failed")
 
+# Canonical auxiliary-object kinds a snapshot carries (single source of truth
+# for re-snapshots and checkpoints).
+OBJECT_FIELDS = ("services", "pvcs", "pvs", "csinodes", "limit_ranges",
+                 "priority_classes", "pdbs", "replication_controllers",
+                 "replica_sets", "stateful_sets", "storage_classes",
+                 "namespaces")
+
 
 def _parse_allocatable(alloc: Mapping) -> Dict[str, int]:
     out: Dict[str, int] = {}
@@ -110,6 +117,7 @@ class ClusterSnapshot:
                      pods: Sequence[Mapping] = (),
                      exclude_nodes: Sequence[str] = (),
                      sort_nodes: bool = True,
+                     node_order: Optional[str] = None,
                      use_native: Optional[bool] = None,
                      **extra_objects) -> "ClusterSnapshot":
         """Build a snapshot the way SyncWithClient does: skip excluded nodes
@@ -128,6 +136,12 @@ class ClusterSnapshot:
                      if (n.get("metadata") or {}).get("name") not in excluded]
         if sort_nodes:
             node_list.sort(key=lambda n: (n.get("metadata") or {}).get("name", ""))
+        if node_order == "zone-round-robin":
+            if use_native:
+                raise ValueError("use_native=True is incompatible with "
+                                 "node_order (native emits the sorted axis)")
+            node_list = zone_round_robin_order(node_list)
+            use_native = False  # native path emits the sorted axis only
         names = [(n.get("metadata") or {}).get("name", "") for n in node_list]
         index_of = {name: i for i, name in enumerate(names)}
 
@@ -215,10 +229,7 @@ class ClusterSnapshot:
 
 
 def _extra_kwargs(extra_objects: Mapping) -> dict:
-    keys = ("services", "pvcs", "pvs", "csinodes", "limit_ranges", "pdbs",
-            "replication_controllers", "replica_sets", "stateful_sets",
-            "storage_classes", "namespaces", "priority_classes")
-    return {k: list(extra_objects.get(k, ())) for k in keys}
+    return {k: list(extra_objects.get(k, ())) for k in OBJECT_FIELDS}
 
 
 def _try_native(nodes, pods, exclude_nodes):
@@ -232,6 +243,27 @@ def _try_native(nodes, pods, exclude_nodes):
             exclude_nodes=exclude_nodes)
     except Exception:
         return None
+
+
+def zone_round_robin_order(node_list: List[dict]) -> List[dict]:
+    """Zone round-robin node ordering (vendor/.../backend/cache/node_tree.go):
+    group by topology.kubernetes.io/zone (region/zone pair), emit one node per
+    zone in rotation — the order the reference's scheduler iterates nodes in.
+    Offered as node_order="zone-round-robin" for behavioral studies; the
+    default sorted order is the parity-mode convention."""
+    zones: Dict[str, List[dict]] = {}
+    for n in node_list:
+        labels = (n.get("metadata") or {}).get("labels") or {}
+        zone = (labels.get("topology.kubernetes.io/region", "") + ":" +
+                labels.get("topology.kubernetes.io/zone", ""))
+        zones.setdefault(zone, []).append(n)
+    ordered: List[dict] = []
+    buckets = [zones[z] for z in sorted(zones)]
+    while buckets:
+        for b in buckets:
+            ordered.append(b.pop(0))
+        buckets = [b for b in buckets if b]
+    return ordered
 
 
 def _normalize_image(name: str) -> str:

@@ -95,8 +95,75 @@ def encode_constraints(snapshot: ClusterSnapshot, pod: Mapping,
     return _encode(snapshot, pod, constraints)
 
 
+def default_selector(snapshot: ClusterSnapshot, pod: Mapping) -> Optional[dict]:
+    """helper.DefaultSelector: merge the selectors of every service/RC/RS/SS
+    that selects the pod (plugins/helper/spread.go); None when nothing does."""
+    meta = pod.get("metadata") or {}
+    ns = meta.get("namespace") or "default"
+    labels = meta.get("labels") or {}
+    match_labels: dict = {}
+    match_exprs: List[dict] = []
+    found = False
+
+    def same_ns(obj):
+        return ((obj.get("metadata") or {}).get("namespace") or "default") == ns
+
+    for svc in snapshot.services:
+        sel = (svc.get("spec") or {}).get("selector") or {}
+        if sel and same_ns(svc) and all(labels.get(k) == v
+                                        for k, v in sel.items()):
+            match_labels.update(sel)
+            found = True
+    for rc in snapshot.replication_controllers:
+        sel = (rc.get("spec") or {}).get("selector") or {}
+        if sel and same_ns(rc) and all(labels.get(k) == v
+                                       for k, v in sel.items()):
+            match_labels.update(sel)
+            found = True
+    for obj in list(snapshot.replica_sets) + list(snapshot.stateful_sets):
+        sel = (obj.get("spec") or {}).get("selector")
+        if sel and same_ns(obj) and match_label_selector(sel, labels):
+            match_labels.update(sel.get("matchLabels") or {})
+            match_exprs.extend(sel.get("matchExpressions") or [])
+            found = True
+    if not found:
+        return None
+    out: dict = {}
+    if match_labels:
+        out["matchLabels"] = match_labels
+    if match_exprs:
+        out["matchExpressions"] = match_exprs
+    return out
+
+
+SYSTEM_DEFAULT_CONSTRAINTS = (
+    # defaultSystemSpread (apis/config/v1/defaults.go): zone maxSkew 3,
+    # hostname maxSkew 5, both ScheduleAnyway.
+    {"maxSkew": 3, "topologyKey": "topology.kubernetes.io/zone",
+     "whenUnsatisfiable": "ScheduleAnyway"},
+    {"maxSkew": 5, "topologyKey": LABEL_HOSTNAME,
+     "whenUnsatisfiable": "ScheduleAnyway"},
+)
+
+
+def encode_system_default(snapshot: ClusterSnapshot,
+                          pod: Mapping) -> SpreadConstraintSet:
+    """System default spreading (buildDefaultConstraints, common.go:58-80):
+    applies only when the pod declares no constraints and some
+    service/RC/RS/SS selects it; soft (score-only) constraints with the merged
+    selector; nodes need not carry every topology key (requireAllTopologies is
+    false for system defaulting, scoring.go:141-145)."""
+    selector = default_selector(snapshot, pod)
+    if selector is None:
+        return _encode(snapshot, pod, [])
+    constraints = [dict(c, labelSelector=selector)
+                   for c in SYSTEM_DEFAULT_CONSTRAINTS]
+    return _encode(snapshot, pod, constraints, require_all=False)
+
+
 def _encode(snapshot: ClusterSnapshot, pod: Mapping,
-            constraints: List[dict]) -> SpreadConstraintSet:
+            constraints: List[dict],
+            require_all: bool = True) -> SpreadConstraintSet:
     n = snapshot.num_nodes
     c_num = len(constraints)
     namespace = (pod.get("metadata") or {}).get("namespace") or "default"
@@ -128,7 +195,10 @@ def _encode(snapshot: ClusterSnapshot, pod: Mapping,
         affinity_policy = c.get("nodeAffinityPolicy") or "Honor"
         taints_policy = c.get("nodeTaintsPolicy") or "Ignore"
         for i in range(n):
-            if not has_all[i]:
+            if require_all:
+                if not has_all[i]:
+                    continue
+            elif node_domain[ci, i] < 0:
                 continue
             ok = True
             if affinity_policy == "Honor":

@@ -1,0 +1,24 @@
+"""PrioritySort (QueueSort plugin): priority desc, then queue time asc.
+
+Reference: vendor/.../scheduler/framework/plugins/queuesort/priority_sort.go
+(Less: higher spec.priority first; ties by QueuedPodInfo timestamp — here the
+pod creationTimestamp stands in, since the simulator enqueues everything at
+snapshot time).  Used to order multi-template sweeps the way the real queue
+would interleave them.
+"""
+
+from __future__ import annotations
+
+from typing import List, Mapping, Sequence
+
+from ..engine.preemption import resolve_priority
+
+
+def sort_pods(pods: Sequence[Mapping],
+              priority_classes: Sequence[Mapping] = ()) -> List[Mapping]:
+    def key(pod):
+        prio = resolve_priority(pod, priority_classes)
+        created = ((pod.get("metadata") or {}).get("creationTimestamp")) or ""
+        return (-prio, created)
+
+    return sorted(pods, key=key)

@@ -37,9 +37,26 @@ def _batchable(pb: enc.EncodedProblem) -> bool:
 
 def sweep(snapshot: ClusterSnapshot, templates: Sequence[dict],
           profile: Optional[SchedulerProfile] = None, max_limit: int = 0,
-          mesh=None) -> List[sim.SolveResult]:
-    """Solve capacity for every template; batched where possible."""
+          mesh=None, queue_sort: bool = False) -> List[sim.SolveResult]:
+    """Solve capacity for every template; batched where possible.
+
+    queue_sort=True orders the templates the way the scheduling queue would
+    (PrioritySort: priority desc, creation asc — ops/priority_sort.py) before
+    solving; results still align with the INPUT order."""
     profile = profile or SchedulerProfile()
+    templates = list(templates)
+    if queue_sort:
+        from ..ops.priority_sort import sort_pods
+        order = sort_pods(templates, snapshot.priority_classes)
+        # solve in queue order, then restore input alignment
+        results_by_id = {}
+        for t in order:
+            results_by_id[id(t)] = None
+        ordered_results = sweep(snapshot, order, profile=profile,
+                                max_limit=max_limit, mesh=mesh)
+        for t, r in zip(order, ordered_results):
+            results_by_id[id(t)] = r
+        return [results_by_id[id(t)] for t in templates]
     problems = [enc.encode_problem(snapshot, t, profile) for t in templates]
 
     results: List[Optional[sim.SolveResult]] = [None] * len(templates)

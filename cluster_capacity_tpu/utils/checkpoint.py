@@ -1,0 +1,54 @@
+"""Tensorized-snapshot checkpointing.
+
+The reference has no checkpoint/resume (each run re-snapshots and discards,
+SURVEY.md §5); since the snapshot here IS a set of tensors, explicit save/load
+is a new capability: an .npz bundle with the resource tensors plus the raw
+objects, so repeated what-if sweeps skip both the API sync and the host
+aggregation."""
+
+from __future__ import annotations
+
+import json
+from typing import List
+
+import numpy as np
+
+from ..models.snapshot import ClusterSnapshot
+
+from ..models.snapshot import OBJECT_FIELDS as _AUX_FIELDS
+
+_OBJECT_FIELDS = ("nodes",) + tuple(_AUX_FIELDS)
+
+
+def _norm(path: str) -> str:
+    return path if path.endswith(".npz") else path + ".npz"
+
+
+def save(path: str, snapshot: ClusterSnapshot) -> None:
+    path = _norm(path)
+    objects = {f: getattr(snapshot, f) for f in _OBJECT_FIELDS}
+    objects["pods_by_node"] = snapshot.pods_by_node
+    np.savez_compressed(
+        path,
+        allocatable=snapshot.allocatable,
+        requested=snapshot.requested,
+        nonzero_requested=snapshot.nonzero_requested,
+        node_names=np.asarray(snapshot.node_names, dtype=object),
+        resource_names=np.asarray(snapshot.resource_names, dtype=object),
+        objects_json=np.asarray(json.dumps(objects)),
+    )
+
+
+def load(path: str) -> ClusterSnapshot:
+    with np.load(_norm(path), allow_pickle=True) as z:
+        objects = json.loads(str(z["objects_json"]))
+        return ClusterSnapshot(
+            nodes=objects["nodes"],
+            node_names=[str(s) for s in z["node_names"]],
+            resource_names=[str(s) for s in z["resource_names"]],
+            allocatable=z["allocatable"],
+            requested=z["requested"],
+            nonzero_requested=z["nonzero_requested"],
+            pods_by_node=objects["pods_by_node"],
+            **{f: objects.get(f, []) for f in _OBJECT_FIELDS if f != "nodes"},
+        )

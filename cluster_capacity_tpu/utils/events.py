@@ -1,0 +1,47 @@
+"""Event recorder.
+
+The reference ships a channel-backed events.EventRecorder that is dead code
+(pkg/framework/record/recorder.go:58-62, unreferenced) and black-holes the
+real broadcaster into a throwaway fake client (pkg/utils/utils.go:139-140).
+This recorder keeps the same Scheduled/FailedScheduling/Preempted vocabulary
+but actually retains events in memory for inspection and report debugging."""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+REASON_SCHEDULED = "Scheduled"
+REASON_FAILED_SCHEDULING = "FailedScheduling"
+REASON_PREEMPTED = "Preempted"
+
+
+@dataclass
+class Event:
+    reason: str
+    message: str
+    object_name: str
+    timestamp: float
+
+
+@dataclass
+class Recorder:
+    max_events: int = 10000
+    events: List[Event] = field(default_factory=list)
+
+    def eventf(self, object_name: str, reason: str, message: str) -> None:
+        if len(self.events) >= self.max_events:
+            del self.events[: self.max_events // 2]
+        self.events.append(Event(reason=reason, message=message,
+                                 object_name=object_name,
+                                 timestamp=time.time()))
+
+    def by_reason(self, reason: str) -> List[Event]:
+        return [e for e in self.events if e.reason == reason]
+
+    def clear(self) -> None:
+        self.events.clear()
+
+
+default_recorder = Recorder()

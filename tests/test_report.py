@@ -1,0 +1,82 @@
+"""Report schema + checkpoint + version + events coverage."""
+
+import io
+import json
+
+from cluster_capacity_tpu import ClusterCapacity, SchedulerProfile
+from cluster_capacity_tpu.models.podspec import default_pod
+from cluster_capacity_tpu.utils import checkpoint
+from cluster_capacity_tpu.utils.report import print_review
+from cluster_capacity_tpu.utils.version import get as get_version
+
+from helpers import build_test_node, build_test_pod
+
+
+def _demo():
+    nodes = [build_test_node(f"n{i}", 2000, 4 * 1024 ** 3, 110)
+             for i in (1, 2)]
+    cc = ClusterCapacity(default_pod(build_test_pod("p", 500, 1024 ** 3)),
+                         profile=SchedulerProfile.parity())
+    cc.sync_with_objects(nodes)
+    cc.run()
+    return cc
+
+
+def test_json_schema_fields():
+    cc = _demo()
+    buf = io.StringIO()
+    print_review(cc.report(), fmt="json", out=buf)
+    data = json.loads(buf.getvalue())
+    assert set(data) == {"spec", "status"}
+    assert data["spec"]["podRequirements"][0]["resources"][
+        "primaryResources"]["nvdia.com/gpu"] == "0"
+    assert data["status"]["failReason"]["failType"] in (
+        "Unschedulable", "LimitReached")
+    rons = data["status"]["pods"][0]["replicasOnNodes"]
+    assert sum(r["replicas"] for r in rons) == data["status"]["replicas"]
+
+
+def test_yaml_and_pretty(capsys=None):
+    cc = _demo()
+    buf = io.StringIO()
+    print_review(cc.report(), fmt="yaml", out=buf)
+    assert "failReason" in buf.getvalue()
+    buf2 = io.StringIO()
+    print_review(cc.report(), verbose=True, out=buf2)
+    assert "Termination reason:" in buf2.getvalue()
+    assert "Pod distribution among nodes:" in buf2.getvalue()
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    cc = _demo()
+    path = str(tmp_path / "snap.npz")
+    checkpoint.save(path, cc.snapshot)
+    loaded = checkpoint.load(path)
+    assert loaded.node_names == cc.snapshot.node_names
+    assert loaded.resource_names == cc.snapshot.resource_names
+    import numpy as np
+    np.testing.assert_array_equal(loaded.allocatable, cc.snapshot.allocatable)
+    # a solve on the loaded snapshot matches
+    cc2 = ClusterCapacity(default_pod(build_test_pod("p", 500, 1024 ** 3)),
+                          profile=SchedulerProfile.parity())
+    cc2.snapshot = loaded
+    assert cc2.run().placed_count == cc._result.placed_count
+
+
+def test_version():
+    info = get_version()
+    assert info.major == "0" and info.version
+
+
+def test_events_recorded():
+    from cluster_capacity_tpu.utils.events import default_recorder
+    default_recorder.clear()
+    nodes = [build_test_node("n1", 1000, int(1e9), 10)]
+    squatter = build_test_pod("squatter", 800, 0, node_name="n1")
+    squatter["spec"]["priority"] = -1
+    incoming = default_pod(build_test_pod("vip", 600, 0))
+    incoming["spec"]["priority"] = 100
+    cc = ClusterCapacity(incoming, profile=SchedulerProfile.parity())
+    cc.sync_with_objects(nodes, [squatter])
+    cc.run()
+    assert default_recorder.by_reason("Preempted")

@@ -1,0 +1,43 @@
+"""System-default topology spreading (buildDefaultConstraints,
+common.go:58-80): pods selected by a Service spread across zones even without
+explicit topologySpreadConstraints."""
+
+from cluster_capacity_tpu import ClusterCapacity, SchedulerProfile
+from cluster_capacity_tpu.models.podspec import default_pod
+from cluster_capacity_tpu.ops.pod_topology_spread import default_selector
+from cluster_capacity_tpu.models.snapshot import ClusterSnapshot
+
+from helpers import build_test_node, build_test_pod
+
+
+def test_default_selector_from_service():
+    nodes = [build_test_node("n1", 1000, 10**9, 10)]
+    svc = {"metadata": {"name": "web", "namespace": "default"},
+           "spec": {"selector": {"app": "web"}}}
+    snapshot = ClusterSnapshot.from_objects(nodes, services=[svc])
+    pod = build_test_pod("p", 10, 0, labels={"app": "web", "x": "y"})
+    assert default_selector(snapshot, pod) == {"matchLabels": {"app": "web"}}
+    other = build_test_pod("q", 10, 0, labels={"app": "db"})
+    assert default_selector(snapshot, other) is None
+
+
+def test_system_default_spreads_across_zones():
+    nodes = []
+    for zone in ("a", "b"):
+        for i in range(2):
+            nodes.append(build_test_node(
+                f"n{zone}{i}", 100000, 10**11, 500,
+                labels={"topology.kubernetes.io/zone": zone,
+                        "kubernetes.io/hostname": f"n{zone}{i}"}))
+    svc = {"metadata": {"name": "web", "namespace": "default"},
+           "spec": {"selector": {"app": "web"}}}
+    pod = default_pod(build_test_pod("p", 10, 0, labels={"app": "web"}))
+    cc = ClusterCapacity(pod, max_limit=20, profile=SchedulerProfile.parity())
+    cc.sync_with_objects(nodes, services=[svc])
+    res = cc.run()
+    assert res.placed_count == 20
+    zone_counts = {"a": 0, "b": 0}
+    for name, cnt in res.per_node_counts.items():
+        zone_counts[name[1]] += cnt
+    # soft spreading balances the zones
+    assert abs(zone_counts["a"] - zone_counts["b"]) <= 2

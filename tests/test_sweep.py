@@ -75,3 +75,18 @@ def test_hard_pod_affinity_weight_steers_score():
     # IPA normalize: magnet=100, plain=0 at weight 2 dominates the taint/
     # balanced ties → first placement lands next to the anchor.
     assert res.placements and res.node_names[res.placements[0]] == "magnet"
+
+
+def test_sweep_queue_sort_alignment():
+    """queue_sort solves in PrioritySort order but returns results aligned
+    with the input template order."""
+    nodes = [build_test_node("n1", 8000, 32 * 1024 ** 3, 110)]
+    snapshot = ClusterSnapshot.from_objects(nodes)
+    low = default_pod(build_test_pod("low", 100, 0))
+    low["spec"]["priority"] = 0
+    high = default_pod(build_test_pod("high", 200, 0))
+    high["spec"]["priority"] = 100
+    results = sweep(snapshot, [low, high], profile=SchedulerProfile.parity(),
+                    max_limit=5, queue_sort=True)
+    assert results[0].placed_count == 5   # low: aligned to input slot 0
+    assert results[1].placed_count == 5
