@@ -40,6 +40,7 @@ CODE_IPA_ANTI = 10
 CODE_IPA_EXISTING_ANTI = 11
 # (volume plugin failures flow through the separate volume_mask/volume_reasons
 # channel — they sit between fit and spread in diagnosis order)
+CODE_DRA = 12
 
 STATIC_REASONS = {
     CODE_UNSCHEDULABLE: node_unschedulable.REASON,
@@ -52,6 +53,9 @@ STATIC_REASONS = {
     CODE_IPA_ANTI: inter_pod_affinity.REASON_ANTI_AFFINITY,
     CODE_IPA_EXISTING_ANTI: inter_pod_affinity.REASON_EXISTING_ANTI,
 }
+
+from ..ops.dynamic_resources import REASON_CANNOT_ALLOCATE as _DRA_REASON
+STATIC_REASONS[CODE_DRA] = _DRA_REASON
 
 
 @dataclass
@@ -89,6 +93,11 @@ class EncodedProblem:
     # pod-level gate: PreFilter/PreEnqueue failure affecting every node
     pod_level_reason: Optional[str]
     pod_level_fail_type: str
+    # DRA shared-claim colocation: after the first placement only the
+    # allocation node remains eligible
+    dra_shared_colocate: bool
+    # devices charged once at the FIRST placement (unallocated shared claims)
+    shared_req_vec: np.ndarray     # f[R]
 
     # static score state
     taint_raw: np.ndarray          # f[N]
@@ -118,6 +127,28 @@ def encode_problem(snapshot: ClusterSnapshot, pod: dict,
         if j is not None:
             req_vec[j] = v
     req_vec[IDX_PODS] = 1.0
+
+    # DRA claims → device pseudo-resource requests (ops/dynamic_resources.py)
+    from ..ops import dynamic_resources as dra
+    dra_on = profile.filter_enabled("DynamicResources")
+    dra_enc = dra.encode(pod, snapshot.resource_claims,
+                         snapshot.resource_claim_templates) if dra_on \
+        else dra.DraEncoding()
+    dra_missing_class = False
+    shared_req_vec = np.zeros(r, dtype=np.float64)
+    for name, v in dra_enc.per_clone_requests.items():
+        j = snapshot.resource_index(name)
+        if j is None:
+            # no node publishes this device class → nothing can place
+            dra_missing_class = True
+        else:
+            req_vec[j] = v
+    for name, v in dra_enc.shared_first_requests.items():
+        j = snapshot.resource_index(name)
+        if j is None:
+            dra_missing_class = True
+        else:
+            shared_req_vec[j] = v
     cpu_nz, mem_nz = ps.pod_nonzero_cpu_mem(pod)
     req_nonzero = np.asarray([cpu_nz, mem_nz], dtype=np.float64)
 
@@ -170,6 +201,14 @@ def encode_problem(snapshot: ClusterSnapshot, pod: dict,
         fold(node_affinity.static_mask(snapshot, pod), CODE_NODE_AFFINITY)
     if enabled("NodePorts"):
         fold(node_ports.static_mask(snapshot, pod), CODE_PORTS)
+    if dra_enc.allocation_node_selectors:
+        from ..models.labels import match_node_selector
+        dra_mask = np.asarray([
+            all(match_node_selector(sel, snapshot.node_labels(i),
+                                    snapshot.node_names[i])
+                for sel in dra_enc.allocation_node_selectors)
+            for i in range(n)], dtype=bool)
+        fold(dra_mask, CODE_DRA)
     static_mask = np.logical_and.reduce(masks) if masks else np.ones(n, dtype=bool)
 
     # --- volume plugins (static, post-fit in plugin order) -------------------
@@ -179,6 +218,10 @@ def encode_problem(snapshot: ClusterSnapshot, pod: dict,
     # PreEnqueue: SchedulingGates holds the pod before it ever enters a cycle
     # (scheduling_gates.go:49); the reference simulator would wait forever —
     # here it fails fast with the kubelet's condition wording.
+    if dra_enc.pod_level_reason:
+        pod_level_reason = dra_enc.pod_level_reason
+    elif dra_missing_class:
+        pod_level_reason = dra.REASON_CANNOT_ALLOCATE
     if (pod.get("spec") or {}).get("schedulingGates"):
         pod_level_reason = ("Scheduling is blocked due to non-empty "
                             "scheduling gates")
@@ -261,6 +304,8 @@ def encode_problem(snapshot: ClusterSnapshot, pod: dict,
         rwop_self_conflict=vol.rwop_self_conflict,
         pod_level_reason=pod_level_reason,
         pod_level_fail_type=pod_level_fail_type,
+        dra_shared_colocate=dra_enc.shared_claim_colocate,
+        shared_req_vec=shared_req_vec,
         taint_raw=taint_raw, node_affinity_raw=na_raw,
         node_affinity_active=na_active, image_locality_score=il_score,
         spread_hard=spread_hard, spread_soft=spread_soft,

@@ -56,6 +56,8 @@ def eligible(pb: enc.EncodedProblem) -> bool:
         return False
     if pb.clone_has_host_ports or pb.volume_self_conflict or pb.rwop_self_conflict:
         return False
+    if pb.dra_shared_colocate:
+        return False
     if sim._num_feasible_nodes_to_find(profile, pb.snapshot.num_nodes) > 0:
         return False
     # TaintToleration normalize is cross-node unless all raw counts are 0

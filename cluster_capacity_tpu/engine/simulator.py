@@ -50,6 +50,7 @@ class StaticConfig(NamedTuple):
     volume_filter_on: bool
     volume_self_conflict: bool
     rwop_self_conflict: bool
+    dra_shared_colocate: bool
     spread_hard_n: int
     spread_soft_n: int
     ipa_filter_on: bool
@@ -93,6 +94,7 @@ def static_config(pb: enc.EncodedProblem) -> StaticConfig:
         volume_filter_on=bool(not pb.volume_mask.all()),
         volume_self_conflict=pb.volume_self_conflict,
         rwop_self_conflict=pb.rwop_self_conflict,
+        dra_shared_colocate=pb.dra_shared_colocate,
         spread_hard_n=pb.spread_hard.num_constraints,
         spread_soft_n=pb.spread_soft.num_constraints,
         ipa_filter_on=profile.filter_enabled("InterPodAffinity") and (
@@ -179,6 +181,7 @@ def build_consts(pb: enc.EncodedProblem) -> Dict[str, "jax.Array"]:
     return {
         "allocatable": f(pb.allocatable),
         "req_vec": f(pb.req_vec),
+        "shared_req_vec": f(pb.shared_req_vec),
         "req_nonzero": f(pb.req_nonzero),
         "static_mask": jnp.asarray(pb.static_mask),
         "taint_raw": f(pb.taint_raw),
@@ -251,8 +254,15 @@ def _feasibility(cfg: StaticConfig, consts, carry: Carry):
     parts = {}
 
     if cfg.fit_filter_on:
+        req_vec = consts["req_vec"]
+        if cfg.dra_shared_colocate:
+            # unallocated shared claim: its devices are requested only by
+            # the FIRST placement (the allocation)
+            import jax.numpy as jnp
+            req_vec = req_vec + jnp.where(carry.placed_count == 0,
+                                          consts["shared_req_vec"], 0.0)
         fitv = fit_ops.fit_filter(consts["allocatable"], carry.requested,
-                                  consts["req_vec"])
+                                  req_vec)
         parts["fit"] = fitv
         feasible = feasible & fitv.mask
 
@@ -267,6 +277,9 @@ def _feasibility(cfg: StaticConfig, consts, carry: Carry):
         feasible = feasible & ~(carry.placed > 0)
     if cfg.rwop_self_conflict:
         feasible = feasible & (carry.placed_count == 0)
+    if cfg.dra_shared_colocate:
+        # shared ResourceClaim: all users share one allocation → colocate
+        feasible = feasible & ((carry.placed > 0) | (carry.placed_count == 0))
 
     if cfg.spread_hard_n > 0:
         sp_ok, sp_missing = spread_ops.hard_filter(
@@ -415,7 +428,11 @@ def _apply_placement(cfg: StaticConfig, consts, carry: Carry, chosen,
         rng = carry.rng
     gate = place.astype(dt)
 
-    requested = carry.requested.at[chosen].add(gate * consts["req_vec"])
+    req_vec = consts["req_vec"]
+    if cfg.dra_shared_colocate:
+        req_vec = req_vec + jnp.where(carry.placed_count == 0,
+                                      consts["shared_req_vec"], 0.0)
+    requested = carry.requested.at[chosen].add(gate * req_vec)
     nonzero = carry.nonzero.at[chosen].add(gate * consts["req_nonzero"])
     placed = carry.placed.at[chosen].add(place.astype(jnp.int32))
 
@@ -603,10 +620,18 @@ def diagnose(pb: enc.EncodedProblem, cfg: StaticConfig, consts,
         if fit_fail[i]:
             if too_many is not None and too_many[i]:
                 add("Too many pods")
+            from ..ops.dynamic_resources import (DRA_RESOURCE_PREFIX,
+                                                 REASON_CANNOT_ALLOCATE)
+            dra_short = False
             if insufficient is not None:
                 for j, rname in enumerate(pb.snapshot.resource_names):
                     if insufficient[i, j]:
-                        add(f"Insufficient {rname}")
+                        if rname.startswith(DRA_RESOURCE_PREFIX):
+                            dra_short = True   # one DRA status per node
+                        else:
+                            add(f"Insufficient {rname}")
+            if dra_short:
+                add(REASON_CANNOT_ALLOCATE)
             continue
         if not pb.volume_mask[i]:
             add(pb.volume_reasons[i] or "volume conflict")

@@ -38,7 +38,8 @@ _TERMINAL_PHASES = ("Succeeded", "Failed")
 OBJECT_FIELDS = ("services", "pvcs", "pvs", "csinodes", "limit_ranges",
                  "priority_classes", "pdbs", "replication_controllers",
                  "replica_sets", "stateful_sets", "storage_classes",
-                 "namespaces")
+                 "namespaces", "resource_slices", "resource_claims",
+                 "resource_claim_templates", "device_classes")
 
 
 def _parse_allocatable(alloc: Mapping) -> Dict[str, int]:
@@ -73,6 +74,11 @@ class ClusterSnapshot:
     stateful_sets: List[dict] = field(default_factory=list)
     storage_classes: List[dict] = field(default_factory=list)
     namespaces: List[dict] = field(default_factory=list)
+    # DRA objects (ops/dynamic_resources.py)
+    resource_slices: List[dict] = field(default_factory=list)
+    resource_claims: List[dict] = field(default_factory=list)
+    resource_claim_templates: List[dict] = field(default_factory=list)
+    device_classes: List[dict] = field(default_factory=list)
 
     @property
     def num_nodes(self) -> int:
@@ -157,6 +163,11 @@ class ClusterSnapshot:
         if use_native and not sort_nodes:
             raise ValueError("use_native=True requires sort_nodes=True "
                              "(the native compiler emits a sorted node axis)")
+        if extra_objects.get("resource_slices"):
+            use_native = False if use_native is None else use_native
+            if use_native:
+                raise ValueError("use_native=True unsupported with "
+                                 "ResourceSlices (DRA device columns)")
         if use_native is not False and sort_nodes:
             if use_native:
                 # explicit request: propagate failures instead of falling back
@@ -199,7 +210,16 @@ class ClusterSnapshot:
                     agg[k] = agg.get(k, 0) + v
             req_maps.append(agg)
             scalars.update(k for k in agg if is_scalar_resource_name(k))
-        resource_names = [RES_PODS, RES_CPU, RES_MEMORY, RES_EPHEMERAL] + sorted(scalars)
+        slices = list(extra_objects.get("resource_slices", ()))
+        dra_classes = set()
+        device_map = {}
+        if slices:
+            from ..ops.dynamic_resources import slice_device_map
+            device_map = slice_device_map(slices)
+            for counts in device_map.values():
+                dra_classes.update(counts)
+        resource_names = [RES_PODS, RES_CPU, RES_MEMORY, RES_EPHEMERAL] + \
+            sorted(scalars) + sorted(dra_classes)
         r_index = {r: i for i, r in enumerate(resource_names)}
 
         n_nodes, n_res = len(node_list), len(resource_names)
@@ -220,6 +240,55 @@ class ClusterSnapshot:
                 cpu, mem = pod_nonzero_cpu_mem(pod)
                 nonzero[i, 0] += cpu
                 nonzero[i, 1] += mem
+
+        if slices:
+            from ..models.labels import match_node_selector
+            from ..ops.dynamic_resources import (
+                _claim_requests, allocation_node_selector, claim_index,
+                template_pod_device_usage)
+            for i in range(n_nodes):
+                for k, v in device_map.get(names[i], {}).items():
+                    allocatable[i, r_index[k]] = v
+            # existing pods' per-pod template claims
+            templates_by_key = claim_index(
+                extra_objects.get("resource_claim_templates", ()))
+            for i in range(n_nodes):
+                for pod in pods_by_node[i]:
+                    for k, v in template_pod_device_usage(
+                            pod, templates_by_key).items():
+                        if k in r_index:
+                            requested[i, r_index[k]] += v
+            # shared claims charged once, claim-centrically: an allocated
+            # claim charges the node its allocation selector targets; an
+            # unallocated claim referenced by existing pods charges the
+            # first referencing pod's node
+            referencing_node = {}
+            for i in range(n_nodes):
+                for pod in pods_by_node[i]:
+                    p_ns = (pod.get("metadata") or {}).get("namespace") or "default"
+                    for ref in (pod.get("spec") or {}).get("resourceClaims") or []:
+                        nm = ref.get("resourceClaimName")
+                        if nm:
+                            referencing_node.setdefault((p_ns, nm), i)
+            for key, claim in claim_index(
+                    extra_objects.get("resource_claims", ())).items():
+                reqs_c = _claim_requests(claim.get("spec") or {})
+                if not reqs_c:
+                    continue
+                target = None
+                selector = allocation_node_selector(claim)
+                if selector is not None:
+                    for i in range(n_nodes):
+                        labels = (node_list[i].get("metadata") or {}).get("labels") or {}
+                        if match_node_selector(selector, labels, names[i]):
+                            target = i
+                            break
+                elif key in referencing_node:
+                    target = referencing_node[key]
+                if target is not None:
+                    for k, v in reqs_c.items():
+                        if k in r_index:
+                            requested[target, r_index[k]] += v
 
         return cls(nodes=node_list, node_names=names,
                    resource_names=resource_names, allocatable=allocatable,

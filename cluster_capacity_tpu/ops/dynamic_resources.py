@@ -1,0 +1,170 @@
+"""DynamicResources (DRA): structured-parameters device allocation, reduced.
+
+Reference: /root/reference/vendor/k8s.io/kubernetes/pkg/scheduler/framework/plugins/dynamicresources/
+(2,439 LoC; PreEnqueue→PreBind).  The capacity-relevant core: pods reference
+ResourceClaims (directly or via resourceClaimTemplates); claims request a
+COUNT of devices of a DeviceClass; nodes publish devices through
+ResourceSlices; the plugin filters nodes whose unallocated devices cannot
+satisfy the claim ("cannot allocate all claims").
+
+TPU-native reduction implemented here:
+- Devices become pseudo-resources `dra/<deviceClassName>` appended to the
+  snapshot's resource axis: per-node allocatable = devices that node's
+  ResourceSlices publish for the class.
+- Template claims (resourceClaimTemplates) are per-pod allocations: each
+  clone charges the claim's device counts (folded into the fit request
+  vector).
+- SHARED named ResourceClaims are allocated ONCE: their devices are charged
+  on the first placement only, every user colocates with the allocation, and
+  a claim that is already allocated (status.allocation) pins all users to
+  the nodes matching its allocation node selector and charges its devices to
+  that node up front.
+
+Out of scope (documented): CEL device selectors, partitionable devices,
+admin access, multi-driver claims — each degrades to count-based matching.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+DRA_RESOURCE_PREFIX = "dra/"
+REASON_CANNOT_ALLOCATE = "cannot allocate all claims"
+
+
+@dataclass
+class DraEncoding:
+    # per-class device counts each clone charges (template claims)
+    per_clone_requests: Dict[str, int] = field(default_factory=dict)
+    # per-class device counts charged once, at the first placement
+    # (unallocated shared claims)
+    shared_first_requests: Dict[str, int] = field(default_factory=dict)
+    # pod references a shared claim → all clones colocate
+    shared_claim_colocate: bool = False
+    # node selectors from already-allocated claims (every one must match)
+    allocation_node_selectors: List[Mapping] = field(default_factory=list)
+    # missing claim/class names → pod-level failure
+    pod_level_reason: Optional[str] = None
+
+
+def slice_device_map(resource_slices: Sequence[Mapping]
+                     ) -> Dict[str, Dict[str, int]]:
+    """One pass over all ResourceSlices → {nodeName: {dra/<class>: count}}.
+
+    ResourceSlice reduced shape: spec.nodeName + spec.devices[] each with a
+    deviceClassName (or spec.driver used as the class fallback)."""
+    out: Dict[str, Dict[str, int]] = {}
+    for rs in resource_slices:
+        spec = rs.get("spec") or {}
+        node = spec.get("nodeName")
+        if not node:
+            continue
+        bucket = out.setdefault(node, {})
+        for dev in spec.get("devices") or []:
+            cls = dev.get("deviceClassName") or spec.get("driver") or ""
+            if cls:
+                key = DRA_RESOURCE_PREFIX + cls
+                bucket[key] = bucket.get(key, 0) + 1
+    return out
+
+
+def node_device_counts(resource_slices: Sequence[Mapping],
+                       node_name: str) -> Dict[str, int]:
+    return slice_device_map(resource_slices).get(node_name, {})
+
+
+def claim_index(resource_claims: Sequence[Mapping]
+                ) -> Dict[Tuple[str, str], dict]:
+    out = {}
+    for c in resource_claims:
+        meta = c.get("metadata") or {}
+        out[(meta.get("namespace") or "default", meta.get("name", ""))] = c
+    return out
+
+
+def _claim_requests(claim_spec: Mapping) -> Dict[str, int]:
+    """Device counts per class from a ResourceClaim spec
+    (spec.devices.requests[]: {deviceClassName, count=1})."""
+    out: Dict[str, int] = {}
+    for req in ((claim_spec.get("devices") or {}).get("requests")) or []:
+        cls = req.get("deviceClassName") or ""
+        if not cls:
+            continue
+        count = int(req.get("count", 1) or 1)
+        out[DRA_RESOURCE_PREFIX + cls] = \
+            out.get(DRA_RESOURCE_PREFIX + cls, 0) + count
+    return out
+
+
+def allocation_node_selector(claim: Mapping) -> Optional[Mapping]:
+    alloc = (claim.get("status") or {}).get("allocation") or {}
+    return alloc.get("nodeSelector")
+
+
+def encode(pod: Mapping, resource_claims: Sequence[Mapping],
+           resource_claim_templates: Sequence[Mapping],
+           namespace_default: str = "default") -> DraEncoding:
+    """Resolve the pod's spec.resourceClaims references."""
+    enc = DraEncoding()
+    spec = pod.get("spec") or {}
+    refs = spec.get("resourceClaims") or []
+    if not refs:
+        return enc
+    ns = (pod.get("metadata") or {}).get("namespace") or namespace_default
+    claims = claim_index(resource_claims)
+    templates = claim_index(resource_claim_templates)
+
+    for ref in refs:
+        claim_name = ref.get("resourceClaimName")
+        tmpl_name = ref.get("resourceClaimTemplateName")
+        if claim_name:
+            claim = claims.get((ns, claim_name))
+            if claim is None:
+                enc.pod_level_reason = \
+                    f'resourceclaim "{claim_name}" not found'
+                return enc
+            enc.shared_claim_colocate = True
+            selector = allocation_node_selector(claim)
+            if selector is not None:
+                # already allocated: pin to the allocation's nodes; devices
+                # were charged to that node at snapshot build
+                enc.allocation_node_selectors.append(selector)
+            else:
+                # unallocated: first clone allocates → devices charged once
+                for k, v in _claim_requests(claim.get("spec") or {}).items():
+                    enc.shared_first_requests[k] = \
+                        enc.shared_first_requests.get(k, 0) + v
+        elif tmpl_name:
+            tmpl = templates.get((ns, tmpl_name))
+            if tmpl is None:
+                enc.pod_level_reason = \
+                    f'resourceclaimtemplate "{tmpl_name}" not found'
+                return enc
+            claim_spec = ((tmpl.get("spec") or {}).get("spec")) or {}
+            for k, v in _claim_requests(claim_spec).items():
+                enc.per_clone_requests[k] = \
+                    enc.per_clone_requests.get(k, 0) + v
+    return enc
+
+
+def template_pod_device_usage(pod: Mapping,
+                              templates_by_key: Dict[Tuple[str, str], dict]
+                              ) -> Dict[str, int]:
+    """Devices an EXISTING pod consumes through claim templates (its own
+    per-pod allocation).  Shared named claims are charged claim-centrically
+    by the snapshot builder, not per pod."""
+    out: Dict[str, int] = {}
+    spec = pod.get("spec") or {}
+    ns = (pod.get("metadata") or {}).get("namespace") or "default"
+    for ref in spec.get("resourceClaims") or []:
+        tmpl_name = ref.get("resourceClaimTemplateName")
+        if not tmpl_name:
+            continue
+        tmpl = templates_by_key.get((ns, tmpl_name))
+        if tmpl is None:
+            continue
+        claim_spec = ((tmpl.get("spec") or {}).get("spec")) or {}
+        for k, v in _claim_requests(claim_spec).items():
+            out[k] = out.get(k, 0) + v
+    return out

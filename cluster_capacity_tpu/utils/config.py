@@ -38,6 +38,9 @@ DEFAULT_FILTERS = [
     "VolumeZone",
     "PodTopologySpread",
     "InterPodAffinity",
+    # DynamicResources sits at the end of the filter chain when the feature
+    # gate is on (default_plugins.go:76); no-op without DRA objects.
+    "DynamicResources",
 ]
 
 # PreEnqueue plugins (SchedulingGates, scheduling_gates.go:49) are modeled as a

@@ -35,6 +35,10 @@ _KIND_TO_KEY = {
     "Namespace": "namespaces",
     "LimitRange": "limit_ranges",
     "PriorityClass": "priority_classes",
+    "ResourceSlice": "resource_slices",
+    "ResourceClaim": "resource_claims",
+    "ResourceClaimTemplate": "resource_claim_templates",
+    "DeviceClass": "device_classes",
 }
 
 SNAPSHOT_KEYS = list(_KIND_TO_KEY.values())

@@ -1,0 +1,160 @@
+"""DynamicResources (DRA) reduced model (ops/dynamic_resources.py): device
+pools from ResourceSlices, per-clone claim templates, shared-claim
+colocation, missing-object pod-level failures."""
+
+from cluster_capacity_tpu import ClusterCapacity, SchedulerProfile
+from cluster_capacity_tpu.models.podspec import default_pod
+
+from helpers import build_test_node, build_test_pod
+
+
+def _slice(node, n_devices, cls="gpu.example.com"):
+    return {"metadata": {"name": f"slice-{node}"},
+            "spec": {"nodeName": node, "driver": cls,
+                     "devices": [{"name": f"dev{i}",
+                                  "deviceClassName": cls}
+                                 for i in range(n_devices)]}}
+
+
+def _claim_template(name, count=1, cls="gpu.example.com"):
+    return {"metadata": {"name": name, "namespace": "default"},
+            "spec": {"spec": {"devices": {"requests": [
+                {"name": "r0", "deviceClassName": cls, "count": count}]}}}}
+
+
+def _pod_with_template_claim(name, claim_tmpl):
+    pod = build_test_pod(name, 100, 0)
+    pod["spec"]["resourceClaims"] = [
+        {"name": "gpu", "resourceClaimTemplateName": claim_tmpl}]
+    return pod
+
+
+def test_device_capacity_bounds_placements():
+    nodes = [build_test_node("n1", 100000, int(1e11), 500),
+             build_test_node("n2", 100000, int(1e11), 500)]
+    slices = [_slice("n1", 4), _slice("n2", 2)]
+    tmpl = _claim_template("one-gpu", count=1)
+    cc = ClusterCapacity(default_pod(_pod_with_template_claim("p", "one-gpu")),
+                         profile=SchedulerProfile.parity())
+    cc.sync_with_objects(nodes, resource_slices=slices,
+                         resource_claim_templates=[tmpl])
+    res = cc.run()
+    assert res.placed_count == 6
+    assert res.per_node_counts == {"n1": 4, "n2": 2}
+    assert res.fail_counts.get("cannot allocate all claims") == 2
+
+
+def test_multi_device_claims():
+    nodes = [build_test_node("n1", 100000, int(1e11), 500)]
+    slices = [_slice("n1", 5)]
+    tmpl = _claim_template("two-gpus", count=2)
+    cc = ClusterCapacity(default_pod(_pod_with_template_claim("p", "two-gpus")),
+                         profile=SchedulerProfile.parity())
+    cc.sync_with_objects(nodes, resource_slices=slices,
+                         resource_claim_templates=[tmpl])
+    res = cc.run()
+    assert res.placed_count == 2   # 5 devices / 2 per pod
+
+
+def test_existing_pod_devices_counted():
+    nodes = [build_test_node("n1", 100000, int(1e11), 500)]
+    slices = [_slice("n1", 3)]
+    tmpl = _claim_template("one-gpu", count=1)
+    existing = _pod_with_template_claim("existing", "one-gpu")
+    existing["spec"]["nodeName"] = "n1"
+    cc = ClusterCapacity(default_pod(_pod_with_template_claim("p", "one-gpu")),
+                         profile=SchedulerProfile.parity())
+    cc.sync_with_objects(nodes, [existing], resource_slices=slices,
+                         resource_claim_templates=[tmpl])
+    res = cc.run()
+    assert res.placed_count == 2   # 3 devices - 1 in use
+
+
+def test_shared_claim_colocates():
+    nodes = [build_test_node("n1", 100000, int(1e11), 500),
+             build_test_node("n2", 100000, int(1e11), 500)]
+    slices = [_slice("n1", 8), _slice("n2", 8)]
+    claim = {"metadata": {"name": "shared", "namespace": "default"},
+             "spec": {"devices": {"requests": [
+                 {"name": "r0", "deviceClassName": "gpu.example.com",
+                  "count": 1}]}}}
+    pod = build_test_pod("p", 100, 0)
+    pod["spec"]["resourceClaims"] = [{"name": "gpu",
+                                      "resourceClaimName": "shared"}]
+    cc = ClusterCapacity(default_pod(pod), max_limit=6,
+                         profile=SchedulerProfile.parity())
+    cc.sync_with_objects(nodes, resource_slices=slices,
+                         resource_claims=[claim])
+    res = cc.run()
+    assert res.placed_count == 6
+    assert len(res.per_node_counts) == 1   # all share one allocation node
+
+
+def test_missing_claim_pod_level():
+    nodes = [build_test_node("n1", 1000, int(1e9), 10)]
+    pod = build_test_pod("p", 100, 0)
+    pod["spec"]["resourceClaims"] = [{"name": "gpu",
+                                      "resourceClaimName": "ghost"}]
+    cc = ClusterCapacity(default_pod(pod), profile=SchedulerProfile.parity())
+    cc.sync_with_objects(nodes, resource_slices=[_slice("n1", 1)])
+    res = cc.run()
+    assert res.placed_count == 0
+    assert 'resourceclaim "ghost" not found' in res.fail_message
+
+
+def test_shared_claim_devices_charged_once():
+    """An unallocated shared claim allocates once: capacity is bounded by pod
+    slots / cpu, not devices-per-clone."""
+    nodes = [build_test_node("n1", 1000, int(1e11), 500)]
+    slices = [_slice("n1", 1)]     # ONE device
+    claim = {"metadata": {"name": "shared", "namespace": "default"},
+             "spec": {"devices": {"requests": [
+                 {"name": "r0", "deviceClassName": "gpu.example.com",
+                  "count": 1}]}}}
+    pod = build_test_pod("p", 100, 0)
+    pod["spec"]["resourceClaims"] = [{"name": "gpu",
+                                      "resourceClaimName": "shared"}]
+    cc = ClusterCapacity(default_pod(pod), profile=SchedulerProfile.parity())
+    cc.sync_with_objects(nodes, resource_slices=slices,
+                         resource_claims=[claim])
+    res = cc.run()
+    # 10 x 100m cpu bound, NOT 1 (the single device serves all users)
+    assert res.placed_count == 10
+
+
+def test_allocated_claim_pins_to_node():
+    nodes = [build_test_node("n1", 100000, int(1e11), 500,
+                             labels={"kubernetes.io/hostname": "n1"}),
+             build_test_node("n2", 100000, int(1e11), 500,
+                             labels={"kubernetes.io/hostname": "n2"})]
+    slices = [_slice("n1", 8), _slice("n2", 8)]
+    claim = {"metadata": {"name": "pinned", "namespace": "default"},
+             "spec": {"devices": {"requests": [
+                 {"name": "r0", "deviceClassName": "gpu.example.com",
+                  "count": 2}]}},
+             "status": {"allocation": {"nodeSelector": {
+                 "nodeSelectorTerms": [{"matchExpressions": [
+                     {"key": "kubernetes.io/hostname", "operator": "In",
+                      "values": ["n2"]}]}]}}}}
+    pod = build_test_pod("p", 100, 0)
+    pod["spec"]["resourceClaims"] = [{"name": "gpu",
+                                      "resourceClaimName": "pinned"}]
+    cc = ClusterCapacity(default_pod(pod), max_limit=4,
+                         profile=SchedulerProfile.parity())
+    cc.sync_with_objects(nodes, resource_slices=slices,
+                         resource_claims=[claim])
+    res = cc.run()
+    assert res.placed_count == 4
+    assert set(res.per_node_counts) == {"n2"}
+
+
+def test_unpublished_device_class_unschedulable():
+    nodes = [build_test_node("n1", 1000, int(1e9), 10)]
+    tmpl = _claim_template("exotic", cls="tpu.example.com")
+    cc = ClusterCapacity(default_pod(_pod_with_template_claim("p", "exotic")),
+                         profile=SchedulerProfile.parity())
+    cc.sync_with_objects(nodes, resource_slices=[_slice("n1", 2)],
+                         resource_claim_templates=[tmpl])
+    res = cc.run()
+    assert res.placed_count == 0
+    assert "cannot allocate all claims" in res.fail_message
