@@ -39,8 +39,12 @@ def build_parser(prog: str = "cluster-capacity") -> argparse.ArgumentParser:
     p.add_argument("--kubeconfig", default="",
                    help="Path to the kubeconfig file to use for the analysis.")
     p.add_argument("--snapshot", default="",
-                   help="Path to a cluster-snapshot YAML/JSON file "
+                   help="Path to a cluster-snapshot YAML/JSON file, or a "
+                        ".npz checkpoint saved with --save-snapshot "
                         "(offline alternative to --kubeconfig).")
+    p.add_argument("--save-snapshot", dest="save_snapshot", default="",
+                   help="Save the loaded cluster state as a tensorized .npz "
+                        "checkpoint for fast reuse.")
     p.add_argument("--podspec", action="append", default=[],
                    help="Path to JSON or YAML file containing pod definition. "
                         "http(s):// URLs are accepted. May be repeated: "
@@ -125,12 +129,18 @@ def run(argv: Optional[List[str]] = None, prog: str = "cluster-capacity") -> int
     if len(pods) == 1:
         cc = ClusterCapacity(pods[0], max_limit=args.max_limit,
                              profile=profile, exclude_nodes=exclude)
-        if args.snapshot:
+        if args.snapshot.endswith(".npz"):
+            from ..utils.checkpoint import load as load_checkpoint
+            cc.snapshot = load_checkpoint(args.snapshot)
+        elif args.snapshot:
             objs = load_snapshot_objects(args.snapshot)
             cc.sync_with_objects(objs.pop("nodes", []), objs.pop("pods", []),
                                  **objs)
         else:
             cc.sync_with_client(_load_live_cluster(args.kubeconfig))
+        if args.save_snapshot:
+            from ..utils.checkpoint import save as save_checkpoint
+            save_checkpoint(args.save_snapshot, cc.snapshot)
         cc.run()
         review = cc.report()
     else:

@@ -1,0 +1,91 @@
+"""CLI front-end smoke tests (in-process run() calls): the reference's
+integration-script assertions (test/integration-tests.sh greps) as pytest."""
+
+import io
+import json
+import sys
+
+import pytest
+
+from cluster_capacity_tpu.cli import cluster_capacity as cc_cli
+from cluster_capacity_tpu.cli import genpod as genpod_cli
+from cluster_capacity_tpu.cli import hypercc
+
+SNAPSHOT = "examples/cluster-snapshot.yaml"
+PODSPEC = "examples/pod.yaml"
+
+
+def _capture(fn, argv):
+    buf = io.StringIO()
+    old = sys.stdout
+    sys.stdout = buf
+    try:
+        rc = fn(argv)
+    finally:
+        sys.stdout = old
+    return rc, buf.getvalue()
+
+
+def test_cluster_capacity_verbose():
+    rc, out = _capture(cc_cli.run, ["--podspec", PODSPEC,
+                                    "--snapshot", SNAPSHOT, "--verbose"])
+    assert rc == 0
+    assert "Termination reason" in out
+    assert "52 instance(s)" in out
+
+
+def test_cluster_capacity_json():
+    rc, out = _capture(cc_cli.run, ["--podspec", PODSPEC,
+                                    "--snapshot", SNAPSHOT, "-o", "json"])
+    assert rc == 0
+    data = json.loads(out)
+    assert data["status"]["replicas"] == 52
+
+
+def test_missing_podspec_errors():
+    rc = cc_cli.run(["--snapshot", SNAPSHOT])
+    assert rc == 1
+
+
+def test_bad_output_format_errors():
+    rc = cc_cli.run(["--podspec", PODSPEC, "--snapshot", SNAPSHOT,
+                     "-o", "xml"])
+    assert rc == 1
+
+
+def test_genpod():
+    rc, out = _capture(genpod_cli.run, ["--snapshot", SNAPSHOT,
+                                        "--namespace", "limited"])
+    assert rc == 0
+    assert "cluster-capacity-stub-container" in out
+    assert "region: primary" in out
+
+
+def test_genpod_missing_namespace():
+    rc, _ = _capture(genpod_cli.run, ["--snapshot", SNAPSHOT,
+                                      "--namespace", "ghost"])
+    assert rc == 1
+
+
+def test_hypercc_dispatch():
+    rc, out = _capture(hypercc.run, ["cluster-capacity", "--podspec", PODSPEC,
+                                     "--snapshot", SNAPSHOT])
+    assert rc == 0
+    assert out.strip() == "52"
+
+
+def test_hypercc_version():
+    rc, out = _capture(hypercc.run, ["--version"])
+    assert rc == 0
+    assert out.startswith("hypercc 0.")
+
+
+def test_snapshot_checkpoint_roundtrip_cli(tmp_path):
+    ckpt = str(tmp_path / "snap.npz")
+    rc, _ = _capture(cc_cli.run, ["--podspec", PODSPEC, "--snapshot", SNAPSHOT,
+                                  "--save-snapshot", ckpt])
+    assert rc == 0
+    rc2, out2 = _capture(cc_cli.run, ["--podspec", PODSPEC,
+                                      "--snapshot", ckpt])
+    assert rc2 == 0
+    assert out2.strip() == "52"
