@@ -176,6 +176,26 @@ class ClusterCapacity:
             raise RuntimeError("call run() first")
         return build_review([self.pod], self._result)
 
+    def scheduled_pods(self) -> List[dict]:
+        """ScheduledPods equivalent (simulator.go:172): the placed clones as
+        pod objects with nodeName set."""
+        if self._result is None:
+            return []
+        from .models.podspec import make_clone
+        out = []
+        for i, idx in enumerate(self._result.placements):
+            clone = make_clone(self.pod, i)
+            clone["spec"]["nodeName"] = self._result.node_names[idx]
+            clone.setdefault("status", {})["phase"] = "Running"
+            out.append(clone)
+        return out
+
+    def close(self) -> None:
+        """Close equivalent (simulator.go:314-325): nothing to tear down —
+        no informers, goroutines, or channels exist in this design."""
+        self.snapshot = None
+        self._result = None
+
 
 def _to_dict(obj):
     """kubernetes-client model → plain k8s JSON dict.

@@ -59,13 +59,19 @@ def sweep(snapshot: ClusterSnapshot, templates: Sequence[dict],
         return [results_by_id[id(t)] for t in templates]
     problems = [enc.encode_problem(snapshot, t, profile) for t in templates]
 
+    from ..engine import fast_path
+
     results: List[Optional[sim.SolveResult]] = [None] * len(templates)
     # Group batchable templates by their StaticConfig — the jitted step
-    # specializes on it, so each group runs as one vmapped solve.
+    # specializes on it, so each group runs as one vmapped solve.  Templates
+    # the analytic fast path can solve outright (unbounded or large-limit
+    # runs) skip the scan entirely — one sort beats K scan steps.
     groups: Dict[tuple, List[int]] = {}
     rest_idx: List[int] = []
     for i, pb in enumerate(problems):
-        if _batchable(pb):
+        if fast_path.eligible(pb) and (not max_limit or max_limit > 4096):
+            rest_idx.append(i)
+        elif _batchable(pb):
             key = (sim.static_config(pb), pb.fit_res_idx.shape,
                    pb.balanced_res_idx.shape, pb.req_vec.shape)
             groups.setdefault(key, []).append(i)
@@ -82,7 +88,7 @@ def sweep(snapshot: ClusterSnapshot, templates: Sequence[dict],
             results[i] = r
 
     for i in rest_idx:
-        results[i] = sim.solve(problems[i], max_limit=max_limit)
+        results[i] = fast_path.solve_auto(problems[i], max_limit=max_limit)
     return results  # type: ignore[return-value]
 
 

@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# Integration smoke (reference: test/integration-tests.sh — run the binary,
+# grep for "Termination reason"); offline via the example snapshot.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec make test-integration

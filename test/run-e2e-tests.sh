@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# e2e runner (reference: test/run-e2e-tests.sh). Without a live cluster this
+# drives the virtual 8-device mesh dryrun; with KUBECONFIG set the live test
+# in tests/test_e2e_live.py also runs via pytest.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+make test-e2e
+if [ -n "${KUBECONFIG:-}" ]; then
+  python -m pytest tests/test_e2e_live.py -q
+fi

@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# Unit-test runner (reference: test/run-unit-test.sh:24-27).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec make test-unit

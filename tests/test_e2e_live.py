@@ -1,0 +1,32 @@
+"""Live-cluster e2e (reference: test/e2e/e2e_test.go:136-174): sync a REAL
+cluster via KUBECONFIG and assert LimitReached at a small limit.  Skips
+unless a kubeconfig and the kubernetes python client are available."""
+
+import os
+
+import pytest
+
+from cluster_capacity_tpu import ClusterCapacity, SchedulerProfile
+from cluster_capacity_tpu.models.podspec import default_pod
+
+kubernetes = pytest.importorskip("kubernetes")
+
+pytestmark = pytest.mark.skipif(
+    not os.environ.get("KUBECONFIG"), reason="KUBECONFIG not set")
+
+
+def test_limit_reached_live():
+    from kubernetes import client, config as kubeconf
+    kubeconf.load_kube_config()
+    pod = default_pod({
+        "metadata": {"name": "e2e-pod"},
+        "spec": {"containers": [{
+            "name": "c0", "image": "registry.k8s.io/pause:3.9",
+            "resources": {"requests": {"cpu": "10m", "memory": "16Mi"}}}]},
+    })
+    cc = ClusterCapacity(pod, max_limit=5, profile=SchedulerProfile.parity())
+    cc.sync_with_client(client.CoreV1Api())
+    res = cc.run()
+    assert res.fail_type in ("LimitReached", "Unschedulable")
+    if res.fail_type == "LimitReached":
+        assert res.placed_count == 5
