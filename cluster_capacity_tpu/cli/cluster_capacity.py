@@ -60,6 +60,10 @@ def build_parser(prog: str = "cluster-capacity") -> argparse.ArgumentParser:
                    help="Verbose mode")
     p.add_argument("-o", "--output", default="",
                    help="Output format. One of: json|yaml.")
+    p.add_argument("--node-order", dest="node_order", default="",
+                   choices=["", "sorted", "zone-round-robin"],
+                   help="Node-axis ordering: sorted (default) or the "
+                        "reference scheduler's zone-round-robin iteration.")
     p.add_argument("--parity", action="store_true",
                    help="Bit-exact kube-scheduler score arithmetic (float64).")
     p.add_argument("--trace", action="store_true",
@@ -134,6 +138,8 @@ def run(argv: Optional[List[str]] = None, prog: str = "cluster-capacity") -> int
             cc.snapshot = load_checkpoint(args.snapshot)
         elif args.snapshot:
             objs = load_snapshot_objects(args.snapshot)
+            if args.node_order == "zone-round-robin":
+                objs["node_order"] = "zone-round-robin"
             cc.sync_with_objects(objs.pop("nodes", []), objs.pop("pods", []),
                                  **objs)
         else:

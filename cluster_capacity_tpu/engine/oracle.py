@@ -552,6 +552,51 @@ def _ipa_scores(state: OracleState, feasible: List[int],
 
 # --- Main loop --------------------------------------------------------------
 
+def simulate_with_preemption(snapshot: ClusterSnapshot, template: dict,
+                             profile: Optional[SchedulerProfile] = None,
+                             max_limit: int = 0):
+    """simulate() plus the DefaultPreemption PostFilter loop — the sequential
+    differential target for framework._solve_with_preemption."""
+    from . import preemption as pre
+
+    profile = profile or SchedulerProfile.parity()
+    placements: List[int] = []
+    reasons: Dict[str, int] = {}
+    working_pods = [p for plist in snapshot.pods_by_node for p in plist]
+    clone_seq = 0
+    while True:
+        snap = ClusterSnapshot.from_objects(
+            snapshot.nodes, working_pods,
+            **{k: getattr(snapshot, k)
+               for k in __import__("cluster_capacity_tpu.models.snapshot",
+                                   fromlist=["OBJECT_FIELDS"]).OBJECT_FIELDS})
+        remaining = (max_limit - len(placements)) if max_limit else 0
+        if max_limit and remaining <= 0:
+            return placements, {}
+        got, reasons = simulate(snap, template, profile, max_limit=remaining)
+        placements.extend(got)
+        if max_limit and len(placements) >= max_limit:
+            return placements, {}
+        if "DefaultPreemption" not in profile.post_filters:
+            return placements, reasons
+        state_pods = [list(p) for p in snap.pods_by_node]
+        for j, idx in enumerate(got):
+            clone = ps.make_clone(template, clone_seq + j)
+            clone["spec"]["nodeName"] = snap.node_names[idx]
+            state_pods[idx].append(clone)
+        outcome = pre.evaluate(snap, state_pods, template, profile)
+        if not outcome.succeeded:
+            return placements, reasons
+        victim_ids = {id(v) for v in outcome.victims}
+        working_pods = [p for plist in snap.pods_by_node for p in plist
+                        if id(p) not in victim_ids]
+        for idx in got:
+            clone = ps.make_clone(template, clone_seq)
+            clone_seq += 1
+            clone["spec"]["nodeName"] = snap.node_names[idx]
+            working_pods.append(clone)
+
+
 def simulate(snapshot: ClusterSnapshot, template: dict,
              profile: Optional[SchedulerProfile] = None,
              max_limit: int = 0):

@@ -604,61 +604,85 @@ def diagnose(pb: enc.EncodedProblem, cfg: StaticConfig, consts,
     counts: Dict[str, int] = {}
 
     def add(reason: str, k: int = 1):
-        counts[reason] = counts.get(reason, 0) + k
+        if k:
+            counts[reason] = counts.get(reason, 0) + int(k)
 
-    for i in range(n):
-        if static_code[i] != enc.CODE_OK:
-            code = int(static_code[i])
-            if code == enc.CODE_TAINT:
+    # Vectorized first-fail attribution in plugin order.  `remaining` tracks
+    # nodes not yet attributed to an earlier plugin.
+    remaining = np.ones(n, dtype=bool)
+
+    # static (pre-fit) codes, incl. per-taint message strings
+    static_fail = static_code != enc.CODE_OK
+    for code in np.unique(static_code[static_fail]):
+        idxs = np.flatnonzero(static_code == code)
+        if int(code) == enc.CODE_TAINT:
+            for i in idxs:
                 add(pb.taint_reasons[i] or "node(s) had untolerated taint")
-            else:
-                add(enc.STATIC_REASONS[code])
-            continue
-        if ports_dyn_fail[i]:
-            add(enc.STATIC_REASONS[enc.CODE_PORTS])
-            continue
-        if fit_fail[i]:
-            if too_many is not None and too_many[i]:
-                add("Too many pods")
-            from ..ops.dynamic_resources import (DRA_RESOURCE_PREFIX,
-                                                 REASON_CANNOT_ALLOCATE)
-            dra_short = False
-            if insufficient is not None:
-                for j, rname in enumerate(pb.snapshot.resource_names):
-                    if insufficient[i, j]:
-                        if rname.startswith(DRA_RESOURCE_PREFIX):
-                            dra_short = True   # one DRA status per node
-                        else:
-                            add(f"Insufficient {rname}")
-            if dra_short:
-                add(REASON_CANNOT_ALLOCATE)
-            continue
-        if not pb.volume_mask[i]:
-            add(pb.volume_reasons[i] or "volume conflict")
-            continue
-        if cfg.volume_self_conflict and np.asarray(carry.placed)[i] > 0:
-            from ..ops.volumes import REASON_DISK_CONFLICT
-            add(REASON_DISK_CONFLICT)
-            continue
-        if cfg.rwop_self_conflict and int(np.asarray(carry.placed_count)) > 0:
-            from ..ops.volumes import REASON_RWOP_CONFLICT
-            add(REASON_RWOP_CONFLICT)
-            continue
-        if spread_missing[i]:
-            add(enc.STATIC_REASONS[enc.CODE_SPREAD_MISSING_LABEL])
-            continue
-        if not spread_ok[i]:
-            add(enc.STATIC_REASONS[enc.CODE_SPREAD])
-            continue
-        if f_aff[i]:
-            add(enc.STATIC_REASONS[enc.CODE_IPA_AFFINITY])
-            continue
-        if f_anti[i]:
-            add(enc.STATIC_REASONS[enc.CODE_IPA_ANTI])
-            continue
-        if f_eanti[i]:
-            add(enc.STATIC_REASONS[enc.CODE_IPA_EXISTING_ANTI])
-            continue
+        else:
+            add(enc.STATIC_REASONS[int(code)], len(idxs))
+    remaining &= ~static_fail
+
+    take = remaining & ports_dyn_fail
+    add(enc.STATIC_REASONS[enc.CODE_PORTS], int(take.sum()))
+    remaining &= ~take
+
+    take = remaining & fit_fail
+    if take.any():
+        from ..ops.dynamic_resources import (DRA_RESOURCE_PREFIX,
+                                             REASON_CANNOT_ALLOCATE)
+        if too_many is not None:
+            add("Too many pods", int((take & too_many).sum()))
+        if insufficient is not None:
+            dra_cols = [j for j, rn in enumerate(pb.snapshot.resource_names)
+                        if rn.startswith(DRA_RESOURCE_PREFIX)]
+            for j, rname in enumerate(pb.snapshot.resource_names):
+                if j in dra_cols:
+                    continue
+                add(f"Insufficient {rname}",
+                    int((take & insufficient[:, j]).sum()))
+            if dra_cols:
+                dra_any = np.logical_or.reduce(
+                    [insufficient[:, j] for j in dra_cols])
+                add(REASON_CANNOT_ALLOCATE, int((take & dra_any).sum()))
+    remaining &= ~take
+
+    vol_fail = ~pb.volume_mask
+    take = remaining & vol_fail
+    for i in np.flatnonzero(take):
+        add(pb.volume_reasons[i] or "volume conflict")
+    remaining &= ~take
+
+    if cfg.volume_self_conflict:
+        placed_np = np.asarray(carry.placed)
+        take = remaining & (placed_np > 0)
+        from ..ops.volumes import REASON_DISK_CONFLICT
+        add(REASON_DISK_CONFLICT, int(take.sum()))
+        remaining &= ~take
+    if cfg.rwop_self_conflict and int(np.asarray(carry.placed_count)) > 0:
+        from ..ops.volumes import REASON_RWOP_CONFLICT
+        add(REASON_RWOP_CONFLICT, int(remaining.sum()))
+        remaining &= False
+    if cfg.dra_shared_colocate and int(np.asarray(carry.placed_count)) > 0:
+        from ..ops.dynamic_resources import REASON_CANNOT_ALLOCATE
+        placed_np = np.asarray(carry.placed)
+        take = remaining & ~(placed_np > 0)
+        add(REASON_CANNOT_ALLOCATE, int(take.sum()))
+        remaining &= ~take
+
+    take = remaining & spread_missing
+    add(enc.STATIC_REASONS[enc.CODE_SPREAD_MISSING_LABEL], int(take.sum()))
+    remaining &= ~take
+    take = remaining & ~spread_ok
+    add(enc.STATIC_REASONS[enc.CODE_SPREAD], int(take.sum()))
+    remaining &= ~take
+
+    for mask, code in ((f_aff, enc.CODE_IPA_AFFINITY),
+                       (f_anti, enc.CODE_IPA_ANTI),
+                       (f_eanti, enc.CODE_IPA_EXISTING_ANTI)):
+        take = remaining & mask
+        add(enc.STATIC_REASONS[code], int(take.sum()))
+        remaining &= ~take
+
     return counts
 
 

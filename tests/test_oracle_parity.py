@@ -110,3 +110,32 @@ def test_differential_sampling():
     expected, _ = oracle.simulate(snapshot, pod, profile, max_limit=60)
     got = sim.solve(enc.encode_problem(snapshot, pod, profile), max_limit=60)
     assert got.placements == expected
+
+
+def test_differential_preemption():
+    """Engine preemption loop vs the oracle's sequential equivalent on
+    randomized priority clusters."""
+    from cluster_capacity_tpu import ClusterCapacity
+
+    for seed in range(4):
+        rng = np.random.RandomState(1000 + seed)
+        nodes = [build_test_node(f"n{i}", int(rng.choice([1000, 2000])),
+                                 int(rng.choice([2, 4])) * 1024 ** 3, 12)
+                 for i in range(5)]
+        pods = []
+        for i in range(5):
+            for k in range(int(rng.randint(3))):
+                p = build_test_pod(f"e{i}{k}", int(rng.choice([200, 500])),
+                                   0, node_name=f"n{i}")
+                p["spec"]["priority"] = int(rng.choice([-10, 0, 5]))
+                pods.append(p)
+        pod = default_pod(build_test_pod("vip", 600, 0))
+        pod["spec"]["priority"] = 10
+        snapshot = ClusterSnapshot.from_objects(nodes, pods)
+        profile = SchedulerProfile.parity()
+        expected, _ = oracle.simulate_with_preemption(snapshot, pod, profile,
+                                                      max_limit=30)
+        cc = ClusterCapacity(pod, max_limit=30, profile=profile)
+        cc.snapshot = snapshot
+        got = cc.run()
+        assert got.placements == expected, f"seed {seed}"
