@@ -130,6 +130,13 @@ def run(argv: Optional[List[str]] = None, prog: str = "cluster-capacity") -> int
 
     exclude = [s for s in args.exclude_nodes.split(",") if s]
 
+    if args.node_order == "zone-round-robin" and (
+            not args.snapshot or args.snapshot.endswith(".npz")):
+        print("Error: --node-order zone-round-robin requires a YAML/JSON "
+              "--snapshot (checkpoints and live sync fix the node axis)",
+              file=sys.stderr)
+        return 1
+
     if len(pods) == 1:
         cc = ClusterCapacity(pods[0], max_limit=args.max_limit,
                              profile=profile, exclude_nodes=exclude)
@@ -162,6 +169,8 @@ def run(argv: Optional[List[str]] = None, prog: str = "cluster-capacity") -> int
 
         from ..utils import metrics as metrics_mod
         from ..utils.trace import SPAN_SNAPSHOT, SPAN_SOLVE, default_tracer
+        if args.node_order == "zone-round-robin":
+            objs["node_order"] = "zone-round-robin"
         with default_tracer.span(SPAN_SNAPSHOT):
             snapshot = ClusterSnapshot.from_objects(
                 objs.pop("nodes", []), objs.pop("pods", []),

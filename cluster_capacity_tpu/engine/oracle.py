@@ -18,7 +18,7 @@ from typing import Dict, List, Mapping, Optional, Tuple
 
 from ..models import labels as lbl
 from ..models import podspec as ps
-from ..models.snapshot import ClusterSnapshot
+from ..models.snapshot import OBJECT_FIELDS, ClusterSnapshot
 from ..utils.config import SchedulerProfile
 
 DNS = ("NoSchedule", "NoExecute")
@@ -554,9 +554,13 @@ def _ipa_scores(state: OracleState, feasible: List[int],
 
 def simulate_with_preemption(snapshot: ClusterSnapshot, template: dict,
                              profile: Optional[SchedulerProfile] = None,
-                             max_limit: int = 0):
+                             max_limit: int = 0,
+                             snapshot_options: Optional[dict] = None):
     """simulate() plus the DefaultPreemption PostFilter loop — the sequential
-    differential target for framework._solve_with_preemption."""
+    differential target for framework._solve_with_preemption.
+
+    `snapshot_options` carries from_objects ordering options (node_order,
+    sort_nodes) so the oracle's node axis matches the engine's."""
     from . import preemption as pre
 
     profile = profile or SchedulerProfile.parity()
@@ -566,10 +570,8 @@ def simulate_with_preemption(snapshot: ClusterSnapshot, template: dict,
     clone_seq = 0
     while True:
         snap = ClusterSnapshot.from_objects(
-            snapshot.nodes, working_pods,
-            **{k: getattr(snapshot, k)
-               for k in __import__("cluster_capacity_tpu.models.snapshot",
-                                   fromlist=["OBJECT_FIELDS"]).OBJECT_FIELDS})
+            snapshot.nodes, working_pods, **(snapshot_options or {}),
+            **{k: getattr(snapshot, k) for k in OBJECT_FIELDS})
         remaining = (max_limit - len(placements)) if max_limit else 0
         if max_limit and remaining <= 0:
             return placements, {}
