@@ -122,7 +122,18 @@ def encode_problem(snapshot: ClusterSnapshot, pod: dict,
     # --- pod request vectors ------------------------------------------------
     reqs = ps.pod_requests(pod)
     req_vec = np.zeros(r, dtype=np.float64)
+    ignored = set(profile.ignored_resources)
+    ignored_groups = set(profile.ignored_resource_groups)
+
+    def _ignored(name: str) -> bool:
+        # fit.go:626-640: only extended resources can be ignored
+        if not is_scalar_resource_name(name):
+            return False
+        return name in ignored or name.split("/")[0] in ignored_groups
+
     for name, v in reqs.items():
+        if _ignored(name):
+            continue
         j = snapshot.resource_index(name)
         if j is not None:
             req_vec[j] = v
@@ -198,7 +209,20 @@ def encode_problem(snapshot: ClusterSnapshot, pod: dict,
         t_mask, taint_reasons = taint_toleration.static_mask_and_reasons(snapshot, pod)
         fold(t_mask, CODE_TAINT)
     if enabled("NodeAffinity"):
-        fold(node_affinity.static_mask(snapshot, pod), CODE_NODE_AFFINITY)
+        na_mask = node_affinity.static_mask(snapshot, pod)
+        if profile.added_affinity:
+            # NodeAffinityArgs.addedAffinity: ANDed with the pod's own
+            # required affinity for every pod of the profile
+            from ..models.labels import match_node_selector
+            required = profile.added_affinity.get(
+                "requiredDuringSchedulingIgnoredDuringExecution")
+            if required:
+                added = np.asarray([
+                    match_node_selector(required, snapshot.node_labels(i),
+                                        snapshot.node_names[i])
+                    for i in range(n)], dtype=bool)
+                na_mask = na_mask & added
+        fold(na_mask, CODE_NODE_AFFINITY)
     if enabled("NodePorts"):
         fold(node_ports.static_mask(snapshot, pod), CODE_PORTS)
     if dra_enc.allocation_node_selectors:
@@ -260,7 +284,10 @@ def encode_problem(snapshot: ClusterSnapshot, pod: dict,
     spread_ignored = pod_topology_spread.static_ignored(spread_soft, require_all)
 
     if enabled("InterPodAffinity") or profile.score_weight("InterPodAffinity"):
-        ipa = inter_pod_affinity.encode(snapshot, pod)
+        ipa = inter_pod_affinity.encode(
+            snapshot, pod,
+            ignore_preferred_terms_of_existing_pods=
+            profile.ignore_preferred_terms_of_existing_pods)
     else:
         ipa = inter_pod_affinity.encode(
             snapshot, {"metadata": pod.get("metadata", {}), "spec": {}})

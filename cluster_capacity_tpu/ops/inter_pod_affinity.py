@@ -118,7 +118,9 @@ class AffinityEncoding:
             bool(np.any(self.static_pref_score != 0.0))
 
 
-def encode(snapshot: ClusterSnapshot, pod: Mapping) -> AffinityEncoding:
+def encode(snapshot: ClusterSnapshot, pod: Mapping,
+           ignore_preferred_terms_of_existing_pods: bool = False
+           ) -> AffinityEncoding:
     n = snapshot.num_nodes
     meta = pod.get("metadata") or {}
     owner_ns = meta.get("namespace") or "default"
@@ -240,8 +242,12 @@ def encode(snapshot: ClusterSnapshot, pod: Mapping) -> AffinityEncoding:
                         add_pair(term.get("topologyKey", ""), i, w)
             # (b) this existing pod's terms vs the incoming pod — processed
             # when the pod has any affinity, or always when the incoming pod
-            # has preferred constraints (scoring.go:145-160, 219-227).
-            if p_has_affinity or has_pref_constraints:
+            # has preferred constraints (scoring.go:145-160, 219-227);
+            # skipped entirely under IgnorePreferredTermsOfExistingPods when
+            # the incoming pod has no preferred constraints (scoring.go:144).
+            if (p_has_affinity or has_pref_constraints) and not (
+                    ignore_preferred_terms_of_existing_pods
+                    and not has_pref_constraints):
                 # required affinity terms score HardPodAffinityWeight
                 # (scoring.go:106-113).
                 for term in _required_terms(p, "podAffinity"):

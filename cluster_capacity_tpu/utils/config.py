@@ -92,6 +92,14 @@ class SchedulerProfile:
     # Scheduler extenders (HTTP webhooks or injected callables); when set the
     # solve runs the host-driven extender loop (engine/extenders.py).
     extenders: List = field(default_factory=list)
+    # NodeAffinityArgs.addedAffinity: extra required node affinity applied to
+    # every pod of the profile (node_affinity.go args).
+    added_affinity: Optional[dict] = None
+    # NodeResourcesFitArgs ignored resources (fit.go:626-640)
+    ignored_resources: List[str] = field(default_factory=list)
+    ignored_resource_groups: List[str] = field(default_factory=list)
+    # InterPodAffinityArgs.ignorePreferredTermsOfExistingPods (scoring.go:144)
+    ignore_preferred_terms_of_existing_pods: bool = False
     # Deterministic tie-break (lowest node index) instead of the reference's
     # reservoir sampling among score ties (schedule_one.go:894-946).
     deterministic: bool = True
@@ -161,6 +169,9 @@ def load_scheduler_config(path: str) -> SchedulerProfile:
     for pc in p0.get("pluginConfig") or []:
         if pc.get("name") == "NodeResourcesFit":
             args = pc.get("args") or {}
+            prof.ignored_resources = list(args.get("ignoredResources") or [])
+            prof.ignored_resource_groups = list(
+                args.get("ignoredResourceGroups") or [])
             strat = args.get("scoringStrategy") or {}
             if strat:
                 resources = [(r.get("name"), int(r.get("weight", 1)))
@@ -174,6 +185,14 @@ def load_scheduler_config(path: str) -> SchedulerProfile:
                     shape_score=[float(s.get("score", 0)) for s in shape]
                     or [0.0, 10.0],
                 )
+        if pc.get("name") == "NodeAffinity":
+            args = pc.get("args") or {}
+            if args.get("addedAffinity"):
+                prof.added_affinity = args["addedAffinity"]
+        if pc.get("name") == "InterPodAffinity":
+            args = pc.get("args") or {}
+            prof.ignore_preferred_terms_of_existing_pods = bool(
+                args.get("ignorePreferredTermsOfExistingPods"))
         if pc.get("name") == "NodeResourcesBalancedAllocation":
             args = pc.get("args") or {}
             res = [(r.get("name"), int(r.get("weight", 1)))

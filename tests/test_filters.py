@@ -190,3 +190,57 @@ def test_extender_filter_and_prioritize():
     assert "n2" not in res.per_node_counts          # extender filtered
     assert res.per_node_counts.get("n3", 0) >= 3    # extender priority wins
     assert calls["filter"] == 4 and calls["prioritize"] == 4
+
+
+def test_plugin_args():
+    """pluginConfig args: addedAffinity, ignoredResources,
+    ignorePreferredTermsOfExistingPods (config loader + encode wiring)."""
+    import yaml as _yaml
+
+    cfg = {
+        "apiVersion": "kubescheduler.config.k8s.io/v1",
+        "kind": "KubeSchedulerConfiguration",
+        "profiles": [{
+            "schedulerName": "default-scheduler",
+            "pluginConfig": [
+                {"name": "NodeAffinity", "args": {"addedAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": {
+                        "nodeSelectorTerms": [{"matchExpressions": [
+                            {"key": "pool", "operator": "In",
+                             "values": ["gold"]}]}]}}}},
+                {"name": "NodeResourcesFit", "args": {
+                    "ignoredResources": ["example.com/widget"]}},
+                {"name": "InterPodAffinity", "args": {
+                    "ignorePreferredTermsOfExistingPods": True}},
+            ],
+        }],
+    }
+    import tempfile, os
+    from cluster_capacity_tpu.utils.config import load_scheduler_config
+    with tempfile.NamedTemporaryFile("w", suffix=".yaml",
+                                     delete=False) as f:
+        _yaml.safe_dump(cfg, f)
+        path = f.name
+    try:
+        profile = load_scheduler_config(path)
+        profile.compute_dtype = "float64"
+    finally:
+        os.unlink(path)
+    assert profile.ignored_resources == ["example.com/widget"]
+    assert profile.ignore_preferred_terms_of_existing_pods
+
+    nodes = [build_test_node("gold1", 1000, int(1e9), 10,
+                             labels={"pool": "gold"}),
+             build_test_node("plain1", 1000, int(1e9), 10)]
+    # pod requests an ignored extended resource no node publishes — ignored,
+    # so it schedules; addedAffinity restricts to the gold node
+    pod = build_test_pod("p", 100, 0)
+    pod["spec"]["containers"][0]["resources"]["requests"][
+        "example.com/widget"] = "1"
+    from cluster_capacity_tpu import ClusterCapacity
+    from cluster_capacity_tpu.models.podspec import default_pod
+    cc = ClusterCapacity(default_pod(pod), profile=profile)
+    cc.sync_with_objects(nodes)
+    res = cc.run()
+    assert res.placed_count > 0
+    assert set(res.per_node_counts) == {"gold1"}
