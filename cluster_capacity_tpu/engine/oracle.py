@@ -335,10 +335,12 @@ def _score_nodes(state: OracleState, feasible: List[int], pod: dict,
             totals[i] += w * int(raw[i])
 
     w = profile.score_weight("PodTopologySpread")
-    if w and _spread_constraints(pod, "ScheduleAnyway"):
-        raw = _spread_scores(state, feasible, pod)
-        for i in feasible:
-            totals[i] += w * raw[i]
+    if w:
+        soft, require_all = _soft_constraints(state, pod)
+        if soft:
+            raw = _spread_scores(state, feasible, pod, soft, require_all)
+            for i in feasible:
+                totals[i] += w * raw[i]
 
     w = profile.score_weight("InterPodAffinity")
     if w:
@@ -403,12 +405,46 @@ def _balanced_score(state: OracleState, i: int, pod: dict,
     return int((1 - std) * 100)
 
 
+def _soft_constraints(state: OracleState, pod: dict):
+    """Pod's ScheduleAnyway constraints, else system-default spreading via
+    the merged service/RC/RS/SS selector (common.go:58-80)."""
+    explicit = _spread_constraints(pod, "ScheduleAnyway")
+    if (pod.get("spec") or {}).get("topologySpreadConstraints"):
+        return explicit, True
+    from ..ops.pod_topology_spread import (SYSTEM_DEFAULT_CONSTRAINTS,
+                                           default_selector)
+    selector = default_selector(state.snapshot, pod)
+    if selector is None:
+        return [], False
+    return [dict(c, labelSelector=selector)
+            for c in SYSTEM_DEFAULT_CONSTRAINTS], False
+
+
+def _spread_countable_soft(state: OracleState, i: int, pod: dict,
+                           constraints: List[dict], c: dict,
+                           require_all: bool) -> bool:
+    if require_all:
+        return _spread_countable(state, i, pod, constraints, c)
+    snap = state.snapshot
+    labels = snap.node_labels(i)
+    if (c.get("topologyKey") or "") not in labels:
+        return False
+    if (c.get("nodeAffinityPolicy") or "Honor") == "Honor":
+        if not lbl.pod_matches_node_selector_and_affinity(
+                pod.get("spec") or {}, labels, snap.node_names[i]):
+            return False
+    if (c.get("nodeTaintsPolicy") or "Ignore") == "Honor":
+        if lbl.find_matching_untolerated_taint(
+                snap.node_taints(i), ps.pod_tolerations(pod), DNS) is not None:
+            return False
+    return True
+
+
 def _spread_scores(state: OracleState, feasible: List[int],
-                   pod: dict) -> Dict[int, int]:
+                   pod: dict, constraints: List[dict],
+                   require_all: bool) -> Dict[int, int]:
     snap = state.snapshot
     ns = (pod.get("metadata") or {}).get("namespace") or "default"
-    constraints = _spread_constraints(pod, "ScheduleAnyway")
-    require_all = bool((pod.get("spec") or {}).get("topologySpreadConstraints"))
     ignored = set()
     for i in feasible:
         labels = snap.node_labels(i)
@@ -430,7 +466,8 @@ def _spread_scores(state: OracleState, feasible: List[int],
                 domains.add(val)
         counts: Dict[str, int] = {}
         for j in range(snap.num_nodes):
-            if not _spread_countable(state, j, pod, constraints, c):
+            if not _spread_countable_soft(state, j, pod, constraints, c,
+                                          require_all):
                 continue
             val = snap.node_labels(j).get(key)
             if val in domains:

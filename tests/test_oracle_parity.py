@@ -139,3 +139,24 @@ def test_differential_preemption():
         cc.snapshot = snapshot
         got = cc.run()
         assert got.placements == expected, f"seed {seed}"
+
+
+def test_differential_system_default_spread():
+    """System-default spreading (service-selected pods, no explicit
+    constraints): engine vs oracle on randomized clusters."""
+    for seed in range(4):
+        rng = np.random.RandomState(2000 + seed)
+        nodes, pods = random_cluster(rng, n_nodes=int(rng.choice([6, 10])))
+        svc = {"metadata": {"name": "web", "namespace": "default"},
+               "spec": {"selector": {"app": "web"}}}
+        pod = default_pod(build_test_pod(
+            "target", int(rng.choice([100, 200])),
+            int(rng.choice([128, 256])) * 1024 ** 2, labels={"app": "web"}))
+        snapshot = ClusterSnapshot.from_objects(
+            nodes, pods, services=[svc],
+            namespaces=[{"metadata": {"name": "default"}}])
+        profile = SchedulerProfile.parity()
+        expected, _ = oracle.simulate(snapshot, pod, profile, max_limit=30)
+        got = sim.solve(enc.encode_problem(snapshot, pod, profile),
+                        max_limit=30)
+        assert got.placements == expected, f"seed {seed}"
