@@ -124,15 +124,18 @@ def solve_fast(pb: enc.EncodedProblem, max_limit: int = 0
 
     w = profile.score_weight("NodeResourcesFit")
     if w:
-        alloc = consts["allocatable"][:, consts["fit_idx"]]    # [N, R']
-        base = jnp.asarray(pb.init_requested, dtype=dt)[:, consts["fit_idx"]]
-        nz_col = jnp.where(consts["fit_idx"] == IDX_CPU, 0, 1)
-        nz_base = jnp.asarray(pb.init_nonzero, dtype=dt)[:, nz_col]
-        base = jnp.where(consts["fit_nz"][None, :], nz_base, base)
-        # per-clone increment: non-zero defaults for cpu/mem columns
-        inc = consts["req_vec"][consts["fit_idx"]]
-        nz_inc = consts["req_nonzero"][nz_col]
-        inc = jnp.where(consts["fit_nz"], nz_inc, inc)
+        cols = list(cfg.fit_idx)
+        alloc = jnp.asarray(pb.allocatable[:, cols], dtype=dt)  # [N, R']
+        base_np = pb.init_requested[:, cols].astype(np.float64)
+        inc_np = pb.req_vec[cols].astype(np.float64)
+        # cpu/mem columns use NonZeroRequested (resource_allocation.go:85-91)
+        for k, j in enumerate(cols):
+            if cfg.fit_nz[k]:
+                nzc = 0 if j == IDX_CPU else 1
+                base_np[:, k] = pb.init_nonzero[:, nzc]
+                inc_np[k] = pb.req_nonzero[nzc]
+        base = jnp.asarray(base_np, dtype=dt)
+        inc = jnp.asarray(inc_np, dtype=dt)
         req = base[:, None, :] + inc[None, None, :] * k_axis[None, :, None] \
             + consts["fit_req"][None, None, :]
         a3 = jnp.broadcast_to(alloc[:, None, :], req.shape)
@@ -157,9 +160,10 @@ def solve_fast(pb: enc.EncodedProblem, max_limit: int = 0
     w = profile.score_weight("NodeResourcesBalancedAllocation")
     if w:
         from ..ops.node_resources_fit import balanced_allocation_score
-        alloc = consts["allocatable"][:, consts["bal_idx"]]
-        base = jnp.asarray(pb.init_requested)[:, consts["bal_idx"]].astype(dt)
-        inc = consts["req_vec"][consts["bal_idx"]]
+        bcols = list(cfg.bal_idx)
+        alloc = jnp.asarray(pb.allocatable[:, bcols], dtype=dt)
+        base = jnp.asarray(pb.init_requested[:, bcols], dtype=dt)
+        inc = jnp.asarray(pb.req_vec[bcols], dtype=dt)
         req = base[:, None, :] + inc[None, None, :] * k_axis[None, :, None] \
             + consts["bal_req"][None, None, :]
         s = balanced_allocation_score(

@@ -29,9 +29,15 @@ from typing import Dict, List, NamedTuple, Optional, Tuple
 import numpy as np
 
 from . import encode as enc
+from ..models.snapshot import IDX_CPU
 from ..ops import inter_pod_affinity as ipa_ops
 from ..ops import node_resources_fit as fit_ops
 from ..ops import pod_topology_spread as spread_ops
+
+# Above this many domains, a soft constraint's dense one-hot membership
+# tensor ([C, D, N]) is too big — soft_score falls back to a scatter for the
+# distinct-domain count instead.
+_ONEHOT_DOMAIN_CAP = 128
 
 FAIL_LIMIT_REACHED = "LimitReached"
 FAIL_UNSCHEDULABLE = "Unschedulable"
@@ -63,9 +69,29 @@ class StaticConfig(NamedTuple):
     weights: Tuple[Tuple[str, int], ...]
     fit_strategy_type: str
     fit_shape: Tuple[Tuple[float, ...], Tuple[float, ...]]
+    # Static resource-column views for the score strategies: baking the
+    # indices into the compiled program turns per-step gathers into slices.
+    fit_idx: Tuple[int, ...]
+    fit_nz: Tuple[bool, ...]
+    bal_idx: Tuple[int, ...]
+    # True when the template's affinity map starts empty (the lonely-pod
+    # escape hatch can only apply then, filtering.go:400-406).
+    ipa_static_empty: bool
+    # True when soft-spread distinct-domain counting can use the dense
+    # one-hot matmul (domain cardinality under _ONEHOT_DOMAIN_CAP).
+    ss_onehot_ok: bool
     # 0 = score all feasible nodes; otherwise numFeasibleNodesToFind
     # (schedule_one.go:697-725) emulated deterministically.
     sample_k: int
+
+
+def _soft_nonhost_domains(ss) -> int:
+    """Max domain cardinality across non-hostname soft constraints."""
+    d_nh = 1
+    for c in range(ss.num_constraints):
+        if not ss.is_hostname[c] and (ss.node_domain[c] >= 0).any():
+            d_nh = max(d_nh, int(ss.node_domain[c].max()) + 1)
+    return d_nh
 
 
 def _num_feasible_nodes_to_find(profile, num_all: int) -> int:
@@ -110,19 +136,30 @@ def static_config(pb: enc.EncodedProblem) -> StaticConfig:
         fit_strategy_type=profile.fit_strategy.type,
         fit_shape=(tuple(profile.fit_strategy.shape_utilization),
                    tuple(profile.fit_strategy.shape_score)),
+        fit_idx=tuple(int(j) for j in pb.fit_res_idx),
+        fit_nz=tuple(bool(b) for b in pb.fit_uses_nonzero),
+        bal_idx=tuple(int(j) for j in pb.balanced_res_idx),
+        ipa_static_empty=bool(ipa.aff_init.sum() == 0),
+        ss_onehot_ok=_soft_nonhost_domains(pb.spread_soft) <= _ONEHOT_DOMAIN_CAP,
         sample_k=_num_feasible_nodes_to_find(profile, pb.snapshot.num_nodes),
     )
 
 
 class Carry(NamedTuple):
+    """The cluster's mutable state.  All topology state is carried as dense
+    PER-NODE count tensors ([C, N]/[G, N], sharded over the node axis on a
+    mesh) rather than domain-indexed maps — every step is then elementwise +
+    reduction work with no gathers/scatters/sorts on the hot path."""
+
     requested: "jax.Array"          # f[N, R]
     nonzero: "jax.Array"            # f[N, 2]
     placed: "jax.Array"             # i32[N]
-    spread_hard: "jax.Array"        # f[Ch, Dh]
-    spread_soft: "jax.Array"        # f[Cs, Ds]
-    aff_dyn: "jax.Array"            # f[G, Da]
-    anti_dyn: "jax.Array"           # f[G, Da]
-    pref_dyn: "jax.Array"           # f[G, Da]
+    sh_cnt: "jax.Array"             # f[Ch, N] — hard-spread match counts
+    ss_cnt: "jax.Array"             # f[Cs, N] — soft-spread match counts
+    aff_cnt: "jax.Array"            # f[G, N] — dynamic affinity counts
+    anti_cnt: "jax.Array"           # f[G, N] — dynamic anti-affinity counts
+    pref_cnt: "jax.Array"           # f[G, N] — dynamic preferred weights
+    aff_total: "jax.Array"          # f[] — total dynamic affinity count
     placed_count: "jax.Array"       # i32
     stopped: "jax.Array"            # bool
     next_start: "jax.Array"         # i32 — rotating sample start index
@@ -173,11 +210,60 @@ def _default_normalize(raw, feasible, reverse: bool):
     return jnp.where(feasible, scaled, 0.0)
 
 
+def _expand_counts(init_counts: np.ndarray, node_domain: np.ndarray) -> np.ndarray:
+    """Materialize counts[c, dom[c, n]] per node (0 where the key is absent) —
+    the static seed of the carried per-node count tensors."""
+    safe = np.clip(node_domain, 0, init_counts.shape[1] - 1)
+    out = np.take_along_axis(init_counts, safe, axis=1)
+    return np.where(node_domain >= 0, out, 0.0)
+
+
 def build_consts(pb: enc.EncodedProblem) -> Dict[str, "jax.Array"]:
     """Move all static arrays to device once, in the profile dtype."""
     import jax.numpy as jnp
     dt = jnp.float64 if pb.profile.compute_dtype == "float64" else jnp.float32
     f = lambda a: jnp.asarray(a, dtype=dt)
+    sh, ss, ipa = pb.spread_hard, pb.spread_soft, pb.ipa
+
+    # Soft-constraint domain membership one-hots for NON-hostname rows: the
+    # per-step distinct-domain count (topology size, scoring.go:141-145)
+    # becomes one small matmul.  Hostname rows stay zero (their size is the
+    # scorable-node count — no domain structure needed).
+    dom_s = ss.node_domain
+    d_nh = 1
+    for c in range(ss.num_constraints):
+        if not ss.is_hostname[c] and (dom_s[c] >= 0).any():
+            d_nh = max(d_nh, int(dom_s[c].max()) + 1)
+    if d_nh > _ONEHOT_DOMAIN_CAP:
+        # high-cardinality topology key: soft_score scatters instead
+        ss_onehot = np.zeros((dom_s.shape[0], 1, dom_s.shape[1]))
+    else:
+        ss_onehot = np.zeros((dom_s.shape[0], d_nh, dom_s.shape[1]))
+        for c in range(ss.num_constraints):
+            if not ss.is_hostname[c]:
+                nodes = np.nonzero(dom_s[c] >= 0)[0]
+                ss_onehot[c, dom_s[c][nodes], nodes] = 1.0
+
+    # Per-GROUP IPA statics: terms sharing a topologyKey read/write the same
+    # merged count row, so per-term bookkeeping folds into group sums.
+    g = ipa.node_domain.shape[0]
+    ghas_aff = np.zeros(g, dtype=bool)
+    ghas_anti = np.zeros(g, dtype=bool)
+    aff_ginc = np.zeros(g)
+    anti_ginc = np.zeros(g)
+    pref_gw = np.zeros(g)
+    for t in range(ipa.num_aff_terms):
+        gi = int(ipa.aff_group[t])
+        ghas_aff[gi] = True
+        aff_ginc[gi] += float(ipa.self_aff_match[t])
+    for t in range(ipa.num_anti_terms):
+        gi = int(ipa.anti_group[t])
+        ghas_anti[gi] = True
+        anti_ginc[gi] += float(ipa.self_anti_match[t])
+    for t in range(ipa.num_pref_terms):
+        pref_gw[int(ipa.pref_group[t])] += \
+            float(ipa.self_pref_match[t]) * float(ipa.pref_weight[t])
+
     return {
         "allocatable": f(pb.allocatable),
         "req_vec": f(pb.req_vec),
@@ -187,39 +273,36 @@ def build_consts(pb: enc.EncodedProblem) -> Dict[str, "jax.Array"]:
         "taint_raw": f(pb.taint_raw),
         "na_raw": f(pb.node_affinity_raw),
         "il_score": f(pb.image_locality_score),
-        "fit_idx": jnp.asarray(pb.fit_res_idx),
         "fit_w": f(pb.fit_res_weights),
         "fit_req": f(pb.fit_req),
-        "fit_nz": jnp.asarray(pb.fit_uses_nonzero),
-        "bal_idx": jnp.asarray(pb.balanced_res_idx),
         "bal_req": f(pb.balanced_req),
         "volume_mask": jnp.asarray(pb.volume_mask),
-        "sh_dom": jnp.asarray(pb.spread_hard.node_domain),
-        "sh_countable": jnp.asarray(pb.spread_hard.node_countable),
-        "sh_valid": jnp.asarray(pb.spread_hard.domain_valid),
-        "sh_skew": f(pb.spread_hard.max_skew),
-        "sh_mindom": f(pb.spread_hard.min_domains),
-        "sh_self": jnp.asarray(pb.spread_hard.self_match),
-        "sh_init": f(pb.spread_hard.init_counts),
-        "ss_dom": jnp.asarray(pb.spread_soft.node_domain),
-        "ss_countable": jnp.asarray(pb.spread_soft.node_countable),
-        "ss_skew": f(pb.spread_soft.max_skew),
-        "ss_self": jnp.asarray(pb.spread_soft.self_match),
-        "ss_init": f(pb.spread_soft.init_counts),
-        "ss_host": jnp.asarray(pb.spread_soft.is_hostname),
-        "ss_node_existing": f(pb.spread_soft.node_existing),
+        "sh_dom": jnp.asarray(sh.node_domain),
+        "sh_countable": jnp.asarray(sh.node_countable),
+        "sh_skew": f(sh.max_skew),
+        "sh_mindom": f(sh.min_domains),
+        "sh_domnum": f(sh.domain_valid.sum(axis=1)),
+        "sh_self": jnp.asarray(sh.self_match),
+        "sh_missing": jnp.asarray(~sh.node_has_all_keys),
+        "sh_cnt_init": f(_expand_counts(sh.init_counts, sh.node_domain)),
+        "ss_dom": jnp.asarray(ss.node_domain),
+        "ss_countable": jnp.asarray(ss.node_countable),
+        "ss_skew": f(ss.max_skew),
+        "ss_self": jnp.asarray(ss.self_match),
+        "ss_host": jnp.asarray(ss.is_hostname),
+        "ss_node_existing": f(ss.node_existing),
         "ss_ignored": jnp.asarray(pb.spread_ignored),
-        "ipa_dom": jnp.asarray(pb.ipa.node_domain),
-        "ipa_aff_group": jnp.asarray(pb.ipa.aff_group),
-        "ipa_anti_group": jnp.asarray(pb.ipa.anti_group),
-        "ipa_pref_group": jnp.asarray(pb.ipa.pref_group),
-        "ipa_aff_init": f(pb.ipa.aff_init),
-        "ipa_anti_init": f(pb.ipa.anti_init),
-        "ipa_self_aff": jnp.asarray(pb.ipa.self_aff_match),
-        "ipa_self_anti": jnp.asarray(pb.ipa.self_anti_match),
-        "ipa_self_pref": jnp.asarray(pb.ipa.self_pref_match),
-        "ipa_pref_w": f(pb.ipa.pref_weight),
-        "ipa_eanti_static": jnp.asarray(pb.ipa.existing_anti_static),
+        "ss_cnt_init": f(_expand_counts(ss.init_counts, ss.node_domain)),
+        "ss_onehot": f(ss_onehot),
+        "ipa_dom": jnp.asarray(ipa.node_domain),
+        "ipa_ghas_aff": jnp.asarray(ghas_aff),
+        "ipa_ghas_anti": jnp.asarray(ghas_anti),
+        "ipa_aff_ginc": f(aff_ginc),
+        "ipa_anti_ginc": f(anti_ginc),
+        "ipa_pref_gw": f(pref_gw),
+        "ipa_aff_scnt": f(_expand_counts(ipa.aff_init, ipa.node_domain)),
+        "ipa_anti_scnt": f(_expand_counts(ipa.anti_init, ipa.node_domain)),
+        "ipa_eanti_static": jnp.asarray(ipa.existing_anti_static),
         "ipa_static_pref": f(pb.ipa.static_pref_score),
     }
 
@@ -230,21 +313,35 @@ def _init_carry(pb: enc.EncodedProblem, consts, seed: int) -> Carry:
     dt = consts["allocatable"].dtype
     n = pb.snapshot.num_nodes
     g = pb.ipa.node_domain.shape[0]
-    d = pb.ipa.max_domains
     return Carry(
         requested=jnp.asarray(pb.init_requested, dtype=dt),
         nonzero=jnp.asarray(pb.init_nonzero, dtype=dt),
         placed=jnp.zeros(n, dtype=jnp.int32),
-        spread_hard=consts["sh_init"],
-        spread_soft=consts["ss_init"],
-        aff_dyn=jnp.zeros((g, d), dtype=dt),
-        anti_dyn=jnp.zeros((g, d), dtype=dt),
-        pref_dyn=jnp.zeros((g, d), dtype=dt),
+        sh_cnt=consts["sh_cnt_init"],
+        ss_cnt=consts["ss_cnt_init"],
+        aff_cnt=jnp.zeros((g, n), dtype=dt),
+        anti_cnt=jnp.zeros((g, n), dtype=dt),
+        pref_cnt=jnp.zeros((g, n), dtype=dt),
+        aff_total=jnp.zeros((), dtype=dt),
         placed_count=jnp.zeros((), dtype=jnp.int32),
         stopped=jnp.zeros((), dtype=bool),
         next_start=jnp.zeros((), dtype=jnp.int32),
         rng=jax.random.PRNGKey(seed),
     )
+
+
+def _col(mat: "jax.Array", chosen: "jax.Array") -> "jax.Array":
+    """mat[:, chosen] as a dynamic slice (no gather)."""
+    import jax
+    return jax.lax.dynamic_slice_in_dim(mat, chosen, 1, axis=1)[:, 0]
+
+
+def _row_add(arr: "jax.Array", idx: "jax.Array", delta: "jax.Array") -> "jax.Array":
+    """arr[idx] += delta via dynamic slice + update (no scatter).  delta must
+    carry the leading singleton axis ([1, ...] / [1])."""
+    import jax
+    row = jax.lax.dynamic_slice_in_dim(arr, idx, 1, axis=0)
+    return jax.lax.dynamic_update_slice_in_dim(arr, row + delta, idx, axis=0)
 
 
 def _feasibility(cfg: StaticConfig, consts, carry: Carry):
@@ -283,22 +380,24 @@ def _feasibility(cfg: StaticConfig, consts, carry: Carry):
 
     if cfg.spread_hard_n > 0:
         sp_ok, sp_missing = spread_ops.hard_filter(
-            carry.spread_hard, consts["sh_dom"], consts["sh_valid"],
-            consts["sh_skew"], consts["sh_mindom"], consts["sh_self"])
+            carry.sh_cnt, consts["sh_dom"], consts["sh_countable"],
+            consts["sh_skew"], consts["sh_mindom"], consts["sh_domnum"],
+            consts["sh_self"], consts["sh_missing"])
         parts["spread_ok"] = sp_ok
         parts["spread_missing"] = sp_missing
         feasible = feasible & sp_ok
 
     if cfg.ipa_filter_on:
-        eanti_dyn = ipa_ops.existing_anti_dynamic_fail(
-            carry.anti_dyn, consts["ipa_dom"], consts["ipa_anti_group"],
-            cfg.ipa_num_anti)
+        import jax.numpy as jnp
+        map_empty = (carry.aff_total == 0) if cfg.ipa_static_empty \
+            else jnp.asarray(False)
         ok, f_aff, f_anti, f_eanti = ipa_ops.filter_all(
-            consts["ipa_aff_init"] + carry.aff_dyn,
-            consts["ipa_anti_init"] + carry.anti_dyn,
-            consts["ipa_dom"], consts["ipa_aff_group"],
-            consts["ipa_anti_group"], cfg.ipa_num_aff, cfg.ipa_num_anti,
-            cfg.ipa_escape_allowed, consts["ipa_eanti_static"], eanti_dyn)
+            consts["ipa_aff_scnt"] + carry.aff_cnt,
+            consts["ipa_anti_scnt"] + carry.anti_cnt,
+            carry.anti_cnt, consts["ipa_dom"],
+            consts["ipa_ghas_aff"], consts["ipa_ghas_anti"],
+            cfg.ipa_num_aff, cfg.ipa_num_anti, map_empty,
+            cfg.ipa_escape_allowed, consts["ipa_eanti_static"])
         parts["ipa"] = (f_aff, f_anti, f_eanti)
         feasible = feasible & ok
     return feasible, parts
@@ -312,12 +411,14 @@ def _scores(cfg: StaticConfig, consts, carry: Carry, feasible):
 
     w = _weight(cfg, "NodeResourcesFit")
     if w:
-        alloc = consts["allocatable"][:, consts["fit_idx"]]
-        req = carry.requested[:, consts["fit_idx"]]
-        # cpu/mem use NonZeroRequested (resource_allocation.go:85-91)
-        nz_col = jnp.where(consts["fit_idx"] == 1, 0, 1)
-        nz_vals = carry.nonzero[:, nz_col]
-        req = jnp.where(consts["fit_nz"][None, :], nz_vals, req)
+        # Static column views (indices baked into the program → slices, not
+        # gathers); cpu/mem use NonZeroRequested (resource_allocation.go:85-91).
+        alloc = jnp.stack([consts["allocatable"][:, j] for j in cfg.fit_idx],
+                          axis=1)
+        req = jnp.stack(
+            [carry.nonzero[:, 0 if j == IDX_CPU else 1] if nz
+             else carry.requested[:, j]
+             for j, nz in zip(cfg.fit_idx, cfg.fit_nz)], axis=1)
         req = req + consts["fit_req"][None, :]
         if cfg.fit_strategy_type == "MostAllocated":
             s = fit_ops.most_allocated_score(alloc, req, consts["fit_w"])
@@ -330,8 +431,10 @@ def _scores(cfg: StaticConfig, consts, carry: Carry, feasible):
 
     w = _weight(cfg, "NodeResourcesBalancedAllocation")
     if w:
-        alloc = consts["allocatable"][:, consts["bal_idx"]]
-        req = carry.requested[:, consts["bal_idx"]] + consts["bal_req"][None, :]
+        alloc = jnp.stack([consts["allocatable"][:, j] for j in cfg.bal_idx],
+                          axis=1)
+        req = jnp.stack([carry.requested[:, j] for j in cfg.bal_idx],
+                        axis=1) + consts["bal_req"][None, :]
         s = fit_ops.balanced_allocation_score(alloc, req)
         total = total + w * jnp.where(feasible, s, 0.0)
 
@@ -351,18 +454,18 @@ def _scores(cfg: StaticConfig, consts, carry: Carry, feasible):
 
     w = _weight(cfg, "PodTopologySpread")
     if w and cfg.spread_soft_n > 0:
-        node_dyn = consts["ss_node_existing"] + \
+        hostname_cnt = consts["ss_node_existing"] + \
             jnp.where(consts["ss_self"][:, None],
                       carry.placed[None, :].astype(dt), 0.0)
         raw, scored = spread_ops.soft_score(
-            carry.spread_soft, node_dyn, consts["ss_dom"], consts["ss_host"],
-            consts["ss_skew"], consts["ss_ignored"], feasible)
+            carry.ss_cnt, hostname_cnt, consts["ss_dom"], consts["ss_host"],
+            consts["ss_skew"], consts["ss_onehot"], consts["ss_ignored"],
+            feasible, use_onehot=cfg.ss_onehot_ok)
         total = total + w * spread_ops.soft_normalize(raw, scored)
 
     w = _weight(cfg, "InterPodAffinity")
     if w and cfg.ipa_score_active:
-        raw = ipa_ops.pref_score(carry.pref_dyn, consts["ipa_dom"],
-                                 consts["ipa_pref_group"],
+        raw = ipa_ops.pref_score(carry.pref_cnt, consts["ipa_dom"],
                                  consts["ipa_static_pref"], cfg.ipa_num_pref)
         total = total + w * ipa_ops.normalize(raw, feasible, True)
 
@@ -383,15 +486,19 @@ def _step(cfg: StaticConfig, consts, carry: Carry):
         # Deterministic emulation of findNodesThatPassFilters' truncation
         # (schedule_one.go:610-694): take the first K feasible nodes in
         # round-robin order from the rotating start index, and advance the
-        # index past the last node examined.
+        # index past the last node examined.  The K-th feasible node's rank
+        # comes from a rotation + prefix sum — no per-step sort.
         n = feasible.shape[0]
         idx = jnp.arange(n, dtype=jnp.int32)
         rank = jnp.remainder(idx - carry.next_start, n)
-        feas_rank = jnp.where(feasible, rank, n)
-        kth = jnp.sort(feas_rank)[min(cfg.sample_k, feasible.shape[0]) - 1]
-        threshold = jnp.where(kth >= n, n - 1, kth)
+        rot = jax.lax.dynamic_slice_in_dim(
+            jnp.concatenate([feasible, feasible]), carry.next_start, n)
+        csum = jnp.cumsum(rot.astype(jnp.int32))
+        reached = csum >= min(cfg.sample_k, n)
+        threshold = jnp.where(jnp.any(reached),
+                              jnp.argmax(reached).astype(jnp.int32), n - 1)
         scorable = feasible & (rank <= threshold)
-        processed = jnp.minimum(threshold + 1, n)
+        processed = threshold + 1
         next_start = jnp.remainder(carry.next_start + processed, n)
 
     total = _scores(cfg, consts, carry, scorable)
@@ -419,7 +526,9 @@ def _step(cfg: StaticConfig, consts, carry: Carry):
 def _apply_placement(cfg: StaticConfig, consts, carry: Carry, chosen,
                      place, next_start=None, rng=None) -> Carry:
     """Commit one placement into the carry (the binder-plugin analog —
-    plugin.go:34-53 sets NodeName+Running; here it is a scatter update)."""
+    plugin.go:34-53 sets NodeName+Running).  All updates are dense or
+    single-row dynamic slices; the topology tensors get their increment via
+    dense_count_update (every node sharing the chosen node's domain)."""
     import jax.numpy as jnp
     dt = _dt(cfg)
     if next_start is None:
@@ -432,47 +541,57 @@ def _apply_placement(cfg: StaticConfig, consts, carry: Carry, chosen,
     if cfg.dra_shared_colocate:
         req_vec = req_vec + jnp.where(carry.placed_count == 0,
                                       consts["shared_req_vec"], 0.0)
-    requested = carry.requested.at[chosen].add(gate * req_vec)
-    nonzero = carry.nonzero.at[chosen].add(gate * consts["req_nonzero"])
-    placed = carry.placed.at[chosen].add(place.astype(jnp.int32))
+    requested = _row_add(carry.requested, chosen, (gate * req_vec)[None, :])
+    nonzero = _row_add(carry.nonzero, chosen,
+                       (gate * consts["req_nonzero"])[None, :])
+    placed = _row_add(carry.placed, chosen,
+                      place.astype(jnp.int32).reshape(1))
 
-    spread_hard = carry.spread_hard
+    sh_cnt = carry.sh_cnt
     if cfg.spread_hard_n > 0:
-        upd = spread_ops.placement_update(
-            carry.spread_hard, consts["sh_dom"], consts["sh_countable"],
-            consts["sh_self"], chosen)
-        spread_hard = jnp.where(place, upd, carry.spread_hard)
-    spread_soft = carry.spread_soft
+        dom_ch = _col(consts["sh_dom"], chosen)
+        inc = (consts["sh_self"] & _col(consts["sh_countable"], chosen)
+               ).astype(dt) * gate
+        sh_cnt = spread_ops.dense_count_update(carry.sh_cnt,
+                                               consts["sh_dom"], dom_ch, inc)
+    ss_cnt = carry.ss_cnt
     if cfg.spread_soft_n > 0:
-        upd = spread_ops.placement_update(
-            carry.spread_soft, consts["ss_dom"], consts["ss_countable"],
-            consts["ss_self"], chosen)
-        spread_soft = jnp.where(place, upd, carry.spread_soft)
+        dom_ch = _col(consts["ss_dom"], chosen)
+        inc = (consts["ss_self"] & _col(consts["ss_countable"], chosen)
+               ).astype(dt) * gate
+        ss_cnt = spread_ops.dense_count_update(carry.ss_cnt,
+                                               consts["ss_dom"], dom_ch, inc)
 
-    aff_dyn, anti_dyn, pref_dyn = carry.aff_dyn, carry.anti_dyn, carry.pref_dyn
+    aff_cnt, anti_cnt, pref_cnt = carry.aff_cnt, carry.anti_cnt, carry.pref_cnt
+    aff_total = carry.aff_total
+    if cfg.ipa_num_aff > 0 or cfg.ipa_num_anti > 0 or cfg.ipa_num_pref > 0:
+        ipa_dom_ch = _col(consts["ipa_dom"], chosen)
+        ipa_valid = (ipa_dom_ch >= 0).astype(dt)
     if cfg.ipa_num_aff > 0:
-        upd = ipa_ops.placement_update(
-            carry.aff_dyn, consts["ipa_dom"], consts["ipa_aff_group"],
-            consts["ipa_self_aff"], chosen)
-        aff_dyn = jnp.where(place, upd, carry.aff_dyn)
+        inc = consts["ipa_aff_ginc"] * ipa_valid * gate
+        aff_cnt = spread_ops.dense_count_update(carry.aff_cnt,
+                                                consts["ipa_dom"],
+                                                ipa_dom_ch, inc)
+        aff_total = carry.aff_total + jnp.sum(inc)
     if cfg.ipa_num_anti > 0:
-        upd = ipa_ops.placement_update(
-            carry.anti_dyn, consts["ipa_dom"], consts["ipa_anti_group"],
-            consts["ipa_self_anti"], chosen)
-        anti_dyn = jnp.where(place, upd, carry.anti_dyn)
+        inc = consts["ipa_anti_ginc"] * ipa_valid * gate
+        anti_cnt = spread_ops.dense_count_update(carry.anti_cnt,
+                                                 consts["ipa_dom"],
+                                                 ipa_dom_ch, inc)
     if cfg.ipa_num_pref > 0:
-        # ipa_pref_w carries the pre-folded per-placement weight: 2x for soft
-        # terms (both directions of processExistingPod apply between identical
-        # clones), 1x HardPodAffinityWeight for required terms.
-        upd = ipa_ops.placement_update(
-            carry.pref_dyn, consts["ipa_dom"], consts["ipa_pref_group"],
-            consts["ipa_self_pref"], chosen, weight=consts["ipa_pref_w"])
-        pref_dyn = jnp.where(place, upd, carry.pref_dyn)
+        # ipa_pref_gw carries the pre-folded per-placement group weight: 2x
+        # for soft terms (both directions of processExistingPod apply between
+        # identical clones), 1x HardPodAffinityWeight for required terms.
+        inc = consts["ipa_pref_gw"] * ipa_valid * gate
+        pref_cnt = spread_ops.dense_count_update(carry.pref_cnt,
+                                                 consts["ipa_dom"],
+                                                 ipa_dom_ch, inc)
 
     return Carry(
         requested=requested, nonzero=nonzero, placed=placed,
-        spread_hard=spread_hard, spread_soft=spread_soft,
-        aff_dyn=aff_dyn, anti_dyn=anti_dyn, pref_dyn=pref_dyn,
+        sh_cnt=sh_cnt, ss_cnt=ss_cnt,
+        aff_cnt=aff_cnt, anti_cnt=anti_cnt, pref_cnt=pref_cnt,
+        aff_total=aff_total,
         placed_count=carry.placed_count + place.astype(jnp.int32),
         stopped=carry.stopped,
         next_start=jnp.where(carry.stopped, carry.next_start, next_start),

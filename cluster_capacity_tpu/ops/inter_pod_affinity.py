@@ -292,47 +292,54 @@ def encode(snapshot: ClusterSnapshot, pod: Mapping,
 
 
 # ---------------------------------------------------------------------------
-# Device-side kernels
+# Device-side kernels (dense per-node count formulation)
+#
+# The scan carries cnt_node[G, N] — per node, its own domain's count in the
+# (merged) topology map of each group — instead of domain-indexed [G, D]
+# maps.  The three filter probes (filtering.go:352-433) then reduce to dense
+# elementwise/reduction work with no gathers inside the step; per-term
+# bookkeeping folds into per-GROUP statics because all terms sharing a
+# topologyKey read the same merged count row.
 # ---------------------------------------------------------------------------
 
-def filter_all(aff_counts: jnp.ndarray, anti_counts: jnp.ndarray,
-               node_domain: jnp.ndarray, aff_group: jnp.ndarray,
-               anti_group: jnp.ndarray, num_aff: int, num_anti: int,
-               escape_allowed: bool, existing_anti_static: jnp.ndarray,
-               existing_anti_dyn_fail: jnp.ndarray
+def filter_all(aff_cnt: jnp.ndarray, anti_cnt: jnp.ndarray,
+               anti_dyn_cnt: jnp.ndarray, node_domain: jnp.ndarray,
+               ghas_aff: jnp.ndarray, ghas_anti: jnp.ndarray,
+               num_aff: int, num_anti: int, map_empty,
+               escape_allowed: bool, existing_anti_static: jnp.ndarray
                ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Run the three probes for every node.
 
+    aff_cnt/anti_cnt: f[G, N] total (static+dynamic) per-node counts;
+    anti_dyn_cnt: f[G, N] dynamic-only counts (placed clones' terms — the
+    satisfyExistingPodsAntiAffinity probe reduces to it because every clone
+    shares the template's terms); ghas_aff/ghas_anti: bool[G] static — group
+    carries ≥1 required (anti-)affinity term; map_empty: traced bool scalar
+    for the lonely-pod escape hatch (filtering.go:400-406).
     Returns (pass, fail_affinity, fail_anti, fail_existing_anti), each bool[N].
     """
     n = node_domain.shape[1]
-    dom = jnp.clip(node_domain, 0, aff_counts.shape[1] - 1).astype(jnp.int32)
     has_key = node_domain >= 0                                  # [G, N]
 
     if num_aff > 0:
-        g = aff_group                                           # [Ta]
-        term_dom = dom[g]                                       # [Ta, N]
-        term_has = has_key[g]
-        cnt = jnp.take_along_axis(aff_counts[g], term_dom, axis=1)
-        term_ok = term_has & (cnt > 0)
-        all_keys = jnp.all(term_has, axis=0)
-        pods_exist = jnp.all(term_ok, axis=0)
-        map_empty = jnp.sum(aff_counts) == 0
+        ok_g = (~ghas_aff[:, None]) | (has_key & (aff_cnt > 0))
+        pods_exist = jnp.all(ok_g, axis=0)
+        all_keys = jnp.all((~ghas_aff[:, None]) | has_key, axis=0)
         escape = all_keys & map_empty & bool(escape_allowed)
         aff_ok = pods_exist | escape
     else:
         aff_ok = jnp.ones(n, dtype=bool)
 
     if num_anti > 0:
-        g = anti_group
-        term_dom = dom[g]
-        term_has = has_key[g]
-        cnt = jnp.take_along_axis(anti_counts[g], term_dom, axis=1)
-        anti_fail = jnp.any(term_has & (cnt > 0), axis=0)
+        anti_fail = jnp.any(ghas_anti[:, None] & has_key & (anti_cnt > 0),
+                            axis=0)
+        eanti_dyn = jnp.any(ghas_anti[:, None] & has_key & (anti_dyn_cnt > 0),
+                            axis=0)
     else:
         anti_fail = jnp.zeros(n, dtype=bool)
+        eanti_dyn = jnp.zeros(n, dtype=bool)
 
-    eanti_fail = existing_anti_static | existing_anti_dyn_fail
+    eanti_fail = existing_anti_static | eanti_dyn
     fail_aff = ~aff_ok
     fail_anti = aff_ok & anti_fail
     fail_eanti = aff_ok & ~anti_fail & eanti_fail
@@ -340,50 +347,14 @@ def filter_all(aff_counts: jnp.ndarray, anti_counts: jnp.ndarray,
     return ok, fail_aff, fail_anti, fail_eanti
 
 
-def existing_anti_dynamic_fail(anti_counts_dyn: jnp.ndarray,
-                               node_domain: jnp.ndarray,
-                               anti_group: jnp.ndarray,
-                               num_anti: int) -> jnp.ndarray:
-    """satisfyExistingPodsAntiAffinity dynamic part: placed clones' required
-    anti-affinity terms.  Because clones share the incoming pod's terms, the
-    check reduces to the incoming-anti probe over the dynamic counts."""
-    n = node_domain.shape[1]
-    if num_anti == 0:
-        return jnp.zeros(n, dtype=bool)
-    dom = jnp.clip(node_domain, 0, anti_counts_dyn.shape[1] - 1).astype(jnp.int32)
-    has_key = node_domain >= 0
-    g = anti_group
-    cnt = jnp.take_along_axis(anti_counts_dyn[g], dom[g], axis=1)
-    return jnp.any(has_key[g] & (cnt > 0), axis=0)
-
-
-def placement_update(counts: jnp.ndarray, node_domain: jnp.ndarray,
-                     group: jnp.ndarray, self_match: jnp.ndarray,
-                     chosen: jnp.ndarray, weight=None) -> jnp.ndarray:
-    """Scatter-add the clone's term contributions at the chosen node's domains.
-
-    counts: f[G, D]; group: i32[T]; self_match: bool[T].  With `weight` given
-    (preferred terms), adds weight instead of 1 — the engine pre-doubles the
-    weight for the both-directions effect (scoring.go:121-127 + :154-160)."""
-    dom = node_domain[group, chosen]                            # [T]
-    amount = self_match.astype(counts.dtype) * (dom >= 0)
-    if weight is not None:
-        amount = amount * weight
-    return counts.at[group, jnp.clip(dom, 0, None)].add(amount)
-
-
-def pref_score(pref_counts: jnp.ndarray, node_domain: jnp.ndarray,
-               pref_group: jnp.ndarray, static_pref: jnp.ndarray,
-               num_pref: int) -> jnp.ndarray:
-    """Raw preferred-term score per node: static + carried dynamic weights."""
+def pref_score(pref_cnt: jnp.ndarray, node_domain: jnp.ndarray,
+               static_pref: jnp.ndarray, num_pref: int) -> jnp.ndarray:
+    """Raw preferred-term score per node: static + carried dynamic weights.
+    Each group's merged row is summed once (scoring.go topologyScore map)."""
     score = static_pref
     if num_pref > 0:
-        dom = jnp.clip(node_domain, 0, pref_counts.shape[1] - 1).astype(jnp.int32)
-        has_key = node_domain >= 0
-        # Sum each group's row once (counts are merged per (key,value) pair,
-        # scoring.go topologyScore map) — not once per term.
-        g_rows = jnp.take_along_axis(pref_counts, dom, axis=1)   # [G, N]
-        score = score + jnp.sum(jnp.where(has_key, g_rows, 0.0), axis=0)
+        score = score + jnp.sum(jnp.where(node_domain >= 0, pref_cnt, 0.0),
+                                axis=0)
     return score
 
 

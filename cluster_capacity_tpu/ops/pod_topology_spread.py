@@ -246,72 +246,90 @@ def _encode(snapshot: ClusterSnapshot, pod: Mapping,
 
 
 # ---------------------------------------------------------------------------
-# Device-side kernels (pure JAX; operate on the carried counts tensor)
+# Device-side kernels (pure JAX; operate on carried PER-NODE count tensors)
+#
+# The carry holds cnt_node[C, N] — each node's own domain's match count —
+# instead of domain-indexed counts[C, D].  Every per-step operation is then
+# dense elementwise/reduction work (VPU-friendly, no gathers/scatters/sorts
+# inside the scan step): the domain lookup counts[c, dom[c, n]] that the Go
+# code does per node (filtering.go:329-339) is pre-materialized and kept
+# up to date incrementally by dense_count_update.
 # ---------------------------------------------------------------------------
 
-def hard_filter(counts: jnp.ndarray, node_domain: jnp.ndarray,
-                domain_valid: jnp.ndarray, max_skew: jnp.ndarray,
-                min_domains: jnp.ndarray, self_match: jnp.ndarray
+def dense_count_update(cnt_node: jnp.ndarray, node_domain: jnp.ndarray,
+                       dom_chosen: jnp.ndarray, inc: jnp.ndarray) -> jnp.ndarray:
+    """Add inc[c] to every node sharing the chosen node's domain (the dense
+    equivalent of counts[c, dom_chosen[c]] += inc[c] followed by re-expansion).
+
+    cnt_node: f[C, N]; node_domain: i32[C, N]; dom_chosen: i32[C]; inc: f[C].
+    """
+    hit = (node_domain == dom_chosen[:, None]) & (node_domain >= 0)
+    return cnt_node + hit.astype(cnt_node.dtype) * inc[:, None]
+
+
+def hard_filter(cnt_node: jnp.ndarray, node_domain: jnp.ndarray,
+                node_countable: jnp.ndarray, max_skew: jnp.ndarray,
+                min_domains: jnp.ndarray, domains_num: jnp.ndarray,
+                self_match: jnp.ndarray, missing: jnp.ndarray
                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Filter over all nodes.  Returns (pass[N], missing_label[N]).
 
-    counts: f[C, D]; node_domain: i32[C, N]; domain_valid: bool[C, D].
+    cnt_node: f[C, N] carried per-node match counts; missing: bool[N] static
+    (node lacks some hard topology key); domains_num: f[C] static count of
+    valid domains (domains never appear or vanish during the simulation).
+
+    minMatchNum (filtering.go:56-69): the min over valid domains equals the
+    min over countable nodes of cnt_node — every valid domain has at least
+    one countable node and all its nodes share one count.
     """
     has_key = node_domain >= 0                               # [C, N]
-    missing = ~jnp.all(has_key, axis=0)                      # [N]
-    # minMatchNum per constraint: min over valid domains; MaxInt when none;
-    # forced to 0 when eligible-domain count < minDomains.
-    masked = jnp.where(domain_valid, counts, _BIG)
+    masked = jnp.where(node_countable, cnt_node, _BIG)
     min_match = jnp.min(masked, axis=1)                      # [C]
-    domains_num = jnp.sum(domain_valid, axis=1)
     min_match = jnp.where(domains_num < min_domains, 0.0, min_match)
 
-    dom = jnp.clip(node_domain, 0, counts.shape[1] - 1).astype(jnp.int32)
-    match_num = jnp.take_along_axis(counts, dom, axis=1)     # [C, N]
-    skew = match_num + self_match[:, None] - min_match[:, None]   # [C, N]
+    skew = cnt_node + self_match[:, None] - min_match[:, None]    # [C, N]
     violated = jnp.any((skew > max_skew[:, None]) & has_key, axis=0)
     return ~(missing | violated), missing
 
 
-def placement_update(counts: jnp.ndarray, node_domain: jnp.ndarray,
-                     node_countable: jnp.ndarray, self_match: jnp.ndarray,
-                     chosen: jnp.ndarray) -> jnp.ndarray:
-    """AddPod (PreFilterExtensions) equivalent: bump the chosen node's domain
-    count for every constraint whose selector matches the clone."""
-    dom = node_domain[:, chosen]                             # [C]
-    inc = (self_match & node_countable[:, chosen] & (dom >= 0)).astype(counts.dtype)
-    one_hot = jnp.zeros_like(counts).at[
-        jnp.arange(counts.shape[0]), jnp.clip(dom, 0, None)].set(inc)
-    return counts + one_hot
-
-
-def soft_score(counts: jnp.ndarray, node_existing_dyn: jnp.ndarray,
+def soft_score(cnt_node: jnp.ndarray, hostname_cnt: jnp.ndarray,
                node_domain: jnp.ndarray, is_hostname: jnp.ndarray,
-               max_skew: jnp.ndarray, ignored: jnp.ndarray,
-               feasible: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+               max_skew: jnp.ndarray, domain_onehot: jnp.ndarray,
+               ignored: jnp.ndarray, feasible: jnp.ndarray,
+               use_onehot: bool = True) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Raw spread score for soft constraints over the current feasible set.
 
-    counts: f[C, D] current domain counts (soft constraints);
-    node_existing_dyn: f[C, N] per-node matching-pod counts (for hostname);
+    cnt_node: f[C, N] carried per-node domain counts (non-hostname rows);
+    hostname_cnt: f[C, N] per-node matching-pod counts (hostname rows);
+    domain_onehot: f[C, Dnh, N] static one-hot domain membership for
+    NON-hostname constraints (zero rows for hostname ones) — the distinct-
+    domain count over the scorable set becomes one small matmul instead of a
+    scatter.  With use_onehot=False (high-cardinality keys, where the dense
+    tensor would be O(N^2)), the count falls back to a scatter-max.
     ignored: bool[N] nodes missing required soft topology labels.
     Returns (raw_score[N], scored[N]) where scored nodes are feasible & ~ignored.
     """
     scorable = feasible & ~ignored
     has_key = node_domain >= 0                               # [C, N]
-    dom = jnp.clip(node_domain, 0, counts.shape[1] - 1).astype(jnp.int32)
 
-    # Domain "present" = some scorable node carries it → defines topology size.
-    c_idx = jnp.arange(counts.shape[0])[:, None]
-    present = jnp.zeros(counts.shape, dtype=bool).at[
-        jnp.broadcast_to(c_idx, dom.shape), dom].max(
+    # Topology size = number of distinct domains among scorable nodes
+    # (scoring.go:141-145); for hostname constraints it is the scorable count.
+    sc_f = scorable.astype(cnt_node.dtype)
+    if use_onehot:
+        present_cnt = jnp.einsum("cdn,n->cd", domain_onehot, sc_f)  # [C, Dnh]
+        topo_size = jnp.sum(present_cnt > 0, axis=1)         # [C]
+    else:
+        c_num, n = node_domain.shape
+        dom = jnp.clip(node_domain, 0, None).astype(jnp.int32)
+        c_idx = jnp.broadcast_to(jnp.arange(c_num)[:, None], dom.shape)
+        present = jnp.zeros((c_num, n), dtype=bool).at[c_idx, dom].max(
             scorable[None, :] & has_key)
-    topo_size = jnp.sum(present, axis=1)                     # [C]
+        topo_size = jnp.sum(present, axis=1)                 # [C]
     host_size = jnp.sum(scorable)
     size = jnp.where(is_hostname, host_size, topo_size)
-    tp_weight = jnp.log(size.astype(counts.dtype) + 2.0)     # [C]
+    tp_weight = jnp.log(size.astype(cnt_node.dtype) + 2.0)   # [C]
 
-    domain_cnt = jnp.take_along_axis(counts, dom, axis=1)    # [C, N]
-    cnt = jnp.where(is_hostname[:, None], node_existing_dyn, domain_cnt)
+    cnt = jnp.where(is_hostname[:, None], hostname_cnt, cnt_node)
     per_c = jnp.where(has_key, cnt * tp_weight[:, None] + (max_skew[:, None] - 1.0),
                       0.0)
     raw = jnp.round(jnp.sum(per_c, axis=0))

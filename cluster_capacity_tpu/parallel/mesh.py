@@ -12,7 +12,9 @@ Sharding layout ("nodes" = model/tensor axis, "batch" = data axis):
 - allocatable/requested [N, R]      → P("nodes", None)
 - per-node masks/scores [N]         → P("nodes")
 - per-constraint domain maps [C, N] → P(None, "nodes")
-- carried domain counts [C, D]      → replicated (small; updated by scatter)
+- carried per-node counts [C, N]    → P(None, "nodes") — topology state is
+  node-sharded too; cross-shard reductions (min over countable nodes, domain
+  presence) become XLA collectives over ICI
 - batched template tensors [B, ...] → P("batch", ...)
 """
 
@@ -54,10 +56,13 @@ def consts_shardings(mesh, consts: Dict[str, "jax.Array"],
         return NamedSharding(mesh, P(*parts))
 
     node_mat = {"allocatable"}
-    node_vec = {"static_mask", "taint_raw", "na_raw", "il_score",
-                "ss_ignored", "ipa_eanti_static", "ipa_static_pref"}
-    cons_by_node = {"sh_dom", "sh_countable", "ss_dom", "ss_countable",
-                    "ss_node_existing", "ipa_dom"}
+    node_vec = {"static_mask", "volume_mask", "taint_raw", "na_raw",
+                "il_score", "ss_ignored", "ipa_eanti_static",
+                "ipa_static_pref", "sh_missing"}
+    cons_by_node = {"sh_dom", "sh_countable", "sh_cnt_init",
+                    "ss_dom", "ss_countable", "ss_cnt_init",
+                    "ss_node_existing", "ipa_dom",
+                    "ipa_aff_scnt", "ipa_anti_scnt"}
     out = {}
     for k, v in consts.items():
         rank = v.ndim - (1 if batched else 0)   # per-problem rank
@@ -67,6 +72,8 @@ def consts_shardings(mesh, consts: Dict[str, "jax.Array"],
             out[k] = spec(NODE_AXIS)
         elif k in cons_by_node:
             out[k] = spec(None, NODE_AXIS)
+        elif k == "ss_onehot":
+            out[k] = spec(None, None, NODE_AXIS)
         else:
             out[k] = spec(*([None] * rank))
     return out
@@ -85,11 +92,13 @@ def carry_shardings(mesh, carry, batched: bool = False):
         requested=spec(NODE_AXIS, None),
         nonzero=spec(NODE_AXIS, None),
         placed=spec(NODE_AXIS),
-        spread_hard=spec(None, None),
-        spread_soft=spec(None, None),
-        aff_dyn=spec(None, None),
-        anti_dyn=spec(None, None),
-        pref_dyn=spec(None, None),
+        # topology state is per-node → sharded over the node axis too
+        sh_cnt=spec(None, NODE_AXIS),
+        ss_cnt=spec(None, NODE_AXIS),
+        aff_cnt=spec(None, NODE_AXIS),
+        anti_cnt=spec(None, NODE_AXIS),
+        pref_cnt=spec(None, NODE_AXIS),
+        aff_total=spec(),
         placed_count=spec(),
         stopped=spec(),
         next_start=spec(),
