@@ -1,0 +1,765 @@
+"""Fused multi-step placement kernel (Pallas/TPU).
+
+The scan engine's only cross-step dependency is argmax -> carry update; the
+per-step compute is tiny (dense ops over the node axis).  On TPU the XLA
+while-loop pays per-op and HBM round-trip latency every step.  This kernel
+runs K greedy steps in ONE device kernel with the whole carry resident in
+VMEM: each step is pure VPU work (elementwise + reductions over [S, 128]
+node planes), so throughput is bounded by actual vector math, not step
+dispatch.  Semantics are bit-identical to engine.simulator._step for the
+supported configuration family (validated by tests/test_fused.py):
+
+- deterministic mode, float32 (the TPU fast path; f64 parity stays on XLA)
+- NodeResourcesFit filter + Least/MostAllocated scoring, balanced allocation
+- TaintToleration / NodeAffinity / ImageLocality static scores + normalize
+- PodTopologySpread HARD constraints (the carried-state filter)
+- InterPodAffinity: all three probes, escape hatch, preferred-term scoring
+- deterministic numFeasibleNodesToFind sampling (binary-searched threshold)
+- NodePorts / volume / DRA clone self-conflict gates
+
+Unsupported (falls back to the XLA scan): f64 parity mode, soft-spread
+scoring (cross-domain presence counting), RequestedToCapacityRatio shapes,
+randomized tie-break.  Reference hot path being replaced:
+vendor/k8s.io/kubernetes/pkg/scheduler/schedule_one.go:610-694.
+
+Array layout: every per-node tensor becomes one [S, 128] f32 "plane"
+(S = ceil(N/128) sublane rows); planes stack into a single [P, S, 128] VMEM
+operand indexed statically.  All per-problem scalars (request vector, skews,
+weights, group increments) are baked into the kernel as literals — the jit
+cache is keyed on the KernelMeta, so repeated solves of one template reuse
+the compiled executable.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from ..models.snapshot import IDX_CPU, IDX_PODS
+from . import simulator as sim
+
+LANES = 128
+_BIG = float(2 ** 31 - 1)
+
+# Hard resource caps keeping the whole working set in VMEM.
+MAX_NODES = 65536
+MAX_R = 16
+MAX_SPREAD = 4
+MAX_GROUPS = 4
+
+
+class KernelMeta(NamedTuple):
+    """Everything the kernel specializes on (hashable -> jit cache key)."""
+
+    n: int                      # real node count
+    s: int                      # sublane rows = ceil(n / 128)
+    r: int                      # resource vocabulary size
+    cfg: sim.StaticConfig
+    req_vec: Tuple[float, ...]
+    req_nonzero: Tuple[float, ...]
+    shared_req_vec: Tuple[float, ...]
+    fit_w: Tuple[float, ...]
+    fit_req: Tuple[float, ...]
+    bal_req: Tuple[float, ...]
+    sh_skew: Tuple[float, ...]
+    sh_mindom: Tuple[float, ...]
+    sh_domnum: Tuple[float, ...]
+    sh_self: Tuple[bool, ...]
+    ghas_aff: Tuple[bool, ...]
+    ghas_anti: Tuple[bool, ...]
+    aff_ginc: Tuple[float, ...]
+    anti_ginc: Tuple[float, ...]
+    pref_gw: Tuple[float, ...]
+    g: int                      # IPA group count
+    ch: int                     # hard-spread constraint count
+    has_taint: bool
+    has_na: bool
+    has_il: bool
+    has_static_pref: bool
+
+
+def eligible(cfg: sim.StaticConfig, pb) -> bool:
+    """Static check: can this problem run on the fused kernel?"""
+    mode = os.environ.get("CC_TPU_FUSED", "auto")
+    if mode == "0":
+        return False
+    if mode != "1":
+        # auto: only where Mosaic actually compiles; on CPU the interpreter
+        # would re-trace per problem for no speedup (tests opt in with =1).
+        import jax
+        if jax.default_backend() == "cpu":
+            return False
+    if cfg.dtype64 or not cfg.deterministic:
+        return False
+    if cfg.spread_soft_n > 0:
+        return False
+    if cfg.fit_strategy_type == "RequestedToCapacityRatio":
+        return False
+    n = pb.snapshot.num_nodes
+    if n == 0 or n > MAX_NODES:
+        return False
+    if pb.snapshot.num_resources > MAX_R:
+        return False
+    if cfg.spread_hard_n > MAX_SPREAD:
+        return False
+    if pb.ipa.node_domain.shape[0] > MAX_GROUPS:
+        return False
+    # >2 balanced resources: the XLA path's single sum reduction and the
+    # kernel's left-fold could associativity-differ on non-integer fractions.
+    if len(cfg.bal_idx) > 2 and sim._weight(cfg, "NodeResourcesBalancedAllocation"):
+        return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# Plane packing
+# ---------------------------------------------------------------------------
+
+def _plane(vec: np.ndarray, s: int, fill: float) -> np.ndarray:
+    out = np.full(s * LANES, fill, dtype=np.float32)
+    out[: vec.shape[0]] = np.asarray(vec, dtype=np.float32)
+    return out.reshape(s, LANES)
+
+
+class _Packing(NamedTuple):
+    meta: KernelMeta
+    const_names: Tuple[str, ...]   # plane order in the const stack
+    carry_names: Tuple[str, ...]   # plane order in the carry stack
+
+    @property
+    def const_idx(self) -> Dict[str, int]:
+        return {k: i for i, k in enumerate(self.const_names)}
+
+    @property
+    def carry_idx(self) -> Dict[str, int]:
+        return {k: i for i, k in enumerate(self.carry_names)}
+
+
+def _pack_meta(cfg: sim.StaticConfig, pb, consts) -> _Packing:
+    n = pb.snapshot.num_nodes
+    s = max(1, -(-n // LANES))
+    r = pb.snapshot.num_resources
+    ipa = pb.ipa
+    g = ipa.node_domain.shape[0]
+    ch = pb.spread_hard.node_domain.shape[0]
+
+    ghas_aff = [False] * g
+    ghas_anti = [False] * g
+    aff_ginc = [0.0] * g
+    anti_ginc = [0.0] * g
+    pref_gw = [0.0] * g
+    for t in range(ipa.num_aff_terms):
+        gi = int(ipa.aff_group[t])
+        ghas_aff[gi] = True
+        aff_ginc[gi] += float(ipa.self_aff_match[t])
+    for t in range(ipa.num_anti_terms):
+        gi = int(ipa.anti_group[t])
+        ghas_anti[gi] = True
+        anti_ginc[gi] += float(ipa.self_anti_match[t])
+    for t in range(ipa.num_pref_terms):
+        pref_gw[int(ipa.pref_group[t])] += \
+            float(ipa.self_pref_match[t]) * float(ipa.pref_weight[t])
+
+    sh = pb.spread_hard
+    meta = KernelMeta(
+        n=n, s=s, r=r, cfg=cfg,
+        req_vec=tuple(float(x) for x in pb.req_vec),
+        req_nonzero=tuple(float(x) for x in pb.req_nonzero),
+        shared_req_vec=tuple(float(x) for x in pb.shared_req_vec),
+        fit_w=tuple(float(x) for x in pb.fit_res_weights),
+        fit_req=tuple(float(x) for x in pb.fit_req),
+        bal_req=tuple(float(x) for x in pb.balanced_req),
+        sh_skew=tuple(float(x) for x in sh.max_skew),
+        sh_mindom=tuple(float(x) for x in sh.min_domains),
+        sh_domnum=tuple(float(x) for x in sh.domain_valid.sum(axis=1)),
+        sh_self=tuple(bool(x) for x in sh.self_match),
+        ghas_aff=tuple(ghas_aff), ghas_anti=tuple(ghas_anti),
+        aff_ginc=tuple(aff_ginc), anti_ginc=tuple(anti_ginc),
+        pref_gw=tuple(pref_gw), g=g, ch=ch,
+        has_taint=bool(sim._weight(cfg, "TaintToleration")),
+        has_na=bool(sim._weight(cfg, "NodeAffinity") and cfg.na_active),
+        has_il=bool(sim._weight(cfg, "ImageLocality")),
+        has_static_pref=bool(cfg.ipa_score_active),
+    )
+
+    const_names = ["static_mask"]
+    if cfg.volume_filter_on:
+        const_names.append("volume_mask")
+    if meta.has_taint:
+        const_names.append("taint_raw")
+    if meta.has_na:
+        const_names.append("na_raw")
+    if meta.has_il:
+        const_names.append("il_score")
+    const_names += [f"alloc{j}" for j in range(r)]
+    if cfg.spread_hard_n > 0:
+        const_names += [f"sh_dom{c}" for c in range(ch)]
+        const_names += [f"sh_countable{c}" for c in range(ch)]
+        const_names.append("sh_missing")
+    if cfg.ipa_filter_on or cfg.ipa_num_aff or cfg.ipa_num_anti \
+            or cfg.ipa_num_pref:
+        const_names += [f"ipa_dom{gi}" for gi in range(g)]
+    if cfg.ipa_filter_on:
+        const_names += [f"ipa_aff_scnt{gi}" for gi in range(g)]
+        const_names += [f"ipa_anti_scnt{gi}" for gi in range(g)]
+        const_names.append("ipa_eanti_static")
+    if meta.has_static_pref:
+        const_names.append("ipa_static_pref")
+
+    carry_names = [f"requested{j}" for j in range(r)]
+    carry_names += ["nonzero0", "nonzero1", "placed"]
+    if cfg.spread_hard_n > 0:
+        carry_names += [f"sh_cnt{c}" for c in range(ch)]
+    if cfg.ipa_num_aff > 0 or cfg.ipa_filter_on:
+        carry_names += [f"aff_cnt{gi}" for gi in range(g)]
+    if cfg.ipa_num_anti > 0 or cfg.ipa_filter_on:
+        carry_names += [f"anti_cnt{gi}" for gi in range(g)]
+    if cfg.ipa_num_pref > 0:
+        carry_names += [f"pref_cnt{gi}" for gi in range(g)]
+
+    return _Packing(meta=meta, const_names=tuple(const_names),
+                    carry_names=tuple(carry_names))
+
+
+def _pack_consts(pk: _Packing, consts) -> np.ndarray:
+    meta, cfg = pk.meta, pk.meta.cfg
+    s = meta.s
+    planes = [None] * len(pk.const_idx)
+
+    def put(name, vec, fill=0.0):
+        planes[pk.const_idx[name]] = _plane(np.asarray(vec), s, fill)
+
+    put("static_mask", np.asarray(consts["static_mask"], dtype=np.float32))
+    if cfg.volume_filter_on:
+        put("volume_mask", np.asarray(consts["volume_mask"], dtype=np.float32))
+    if meta.has_taint:
+        put("taint_raw", consts["taint_raw"])
+    if meta.has_na:
+        put("na_raw", consts["na_raw"])
+    if meta.has_il:
+        put("il_score", consts["il_score"])
+    alloc = np.asarray(consts["allocatable"])
+    for j in range(meta.r):
+        put(f"alloc{j}", alloc[:, j])
+    if cfg.spread_hard_n > 0:
+        dom = np.asarray(consts["sh_dom"], dtype=np.float32)
+        countable = np.asarray(consts["sh_countable"], dtype=np.float32)
+        for c in range(meta.ch):
+            put(f"sh_dom{c}", dom[c], fill=-1.0)
+            put(f"sh_countable{c}", countable[c])
+        put("sh_missing", np.asarray(consts["sh_missing"], dtype=np.float32),
+            fill=1.0)
+    if any(k.startswith("ipa_dom") for k in pk.const_idx):
+        dom = np.asarray(consts["ipa_dom"], dtype=np.float32)
+        for gi in range(meta.g):
+            put(f"ipa_dom{gi}", dom[gi], fill=-1.0)
+    if cfg.ipa_filter_on:
+        aff_s = np.asarray(consts["ipa_aff_scnt"])
+        anti_s = np.asarray(consts["ipa_anti_scnt"])
+        for gi in range(meta.g):
+            put(f"ipa_aff_scnt{gi}", aff_s[gi])
+            put(f"ipa_anti_scnt{gi}", anti_s[gi])
+        put("ipa_eanti_static",
+            np.asarray(consts["ipa_eanti_static"], dtype=np.float32))
+    if meta.has_static_pref:
+        put("ipa_static_pref", consts["ipa_static_pref"])
+    return np.stack(planes)
+
+
+def _pack_carry(pk: _Packing, carry: sim.Carry) -> Tuple[np.ndarray, np.ndarray]:
+    meta = pk.meta
+    s = meta.s
+    planes = [None] * len(pk.carry_idx)
+
+    def put(name, vec):
+        planes[pk.carry_idx[name]] = _plane(np.asarray(vec), s, 0.0)
+
+    req = np.asarray(carry.requested)
+    for j in range(meta.r):
+        put(f"requested{j}", req[:, j])
+    nz = np.asarray(carry.nonzero)
+    put("nonzero0", nz[:, 0])
+    put("nonzero1", nz[:, 1])
+    put("placed", np.asarray(carry.placed, dtype=np.float32))
+    if f"sh_cnt0" in pk.carry_idx:
+        cnt = np.asarray(carry.sh_cnt)
+        for c in range(meta.ch):
+            put(f"sh_cnt{c}", cnt[c])
+    for stem, arr in (("aff_cnt", carry.aff_cnt), ("anti_cnt", carry.anti_cnt),
+                      ("pref_cnt", carry.pref_cnt)):
+        if f"{stem}0" in pk.carry_idx:
+            a = np.asarray(arr)
+            for gi in range(meta.g):
+                put(f"{stem}{gi}", a[gi])
+    scalars = np.asarray([[float(np.asarray(carry.placed_count)),
+                           float(bool(np.asarray(carry.stopped))),
+                           float(np.asarray(carry.next_start)),
+                           float(np.asarray(carry.aff_total))]],
+                         dtype=np.float32)
+    return np.stack(planes), scalars
+
+
+def _unpack_carry(pk: _Packing, planes: np.ndarray, scalars: np.ndarray,
+                  template: sim.Carry) -> sim.Carry:
+    """Write the kernel's planes back into a standard Carry."""
+    import jax.numpy as jnp
+    meta = pk.meta
+    n = meta.n
+    flat = np.asarray(planes).reshape(planes.shape[0], -1)[:, :n]
+
+    def rows(stem, count):
+        return np.stack([flat[pk.carry_idx[f"{stem}{i}"]] for i in range(count)])
+
+    requested = rows("requested", meta.r).T
+    nonzero = np.stack([flat[pk.carry_idx["nonzero0"]],
+                        flat[pk.carry_idx["nonzero1"]]]).T
+    placed = flat[pk.carry_idx["placed"]].astype(np.int32)
+    sc = np.asarray(scalars)[0]
+    dt = template.requested.dtype
+    return template._replace(
+        requested=jnp.asarray(requested, dtype=dt),
+        nonzero=jnp.asarray(nonzero, dtype=dt),
+        placed=jnp.asarray(placed),
+        sh_cnt=jnp.asarray(rows("sh_cnt", meta.ch), dtype=dt)
+        if "sh_cnt0" in pk.carry_idx else template.sh_cnt,
+        aff_cnt=jnp.asarray(rows("aff_cnt", meta.g), dtype=dt)
+        if "aff_cnt0" in pk.carry_idx else template.aff_cnt,
+        anti_cnt=jnp.asarray(rows("anti_cnt", meta.g), dtype=dt)
+        if "anti_cnt0" in pk.carry_idx else template.anti_cnt,
+        pref_cnt=jnp.asarray(rows("pref_cnt", meta.g), dtype=dt)
+        if "pref_cnt0" in pk.carry_idx else template.pref_cnt,
+        placed_count=jnp.asarray(int(round(sc[0])), dtype=jnp.int32),
+        stopped=jnp.asarray(bool(round(sc[1]))),
+        next_start=jnp.asarray(int(round(sc[2])), dtype=jnp.int32),
+        aff_total=jnp.asarray(sc[3], dtype=dt),
+    )
+
+
+# ---------------------------------------------------------------------------
+# The kernel
+# ---------------------------------------------------------------------------
+
+def _floor_div(num, den):
+    import jax.numpy as jnp
+    return jnp.floor(num / jnp.maximum(den, 1e-30))
+
+
+def _build_kernel(pk: _Packing, k_steps: int):
+    """Returns the Pallas kernel body for k_steps fused placement steps."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    meta, cfg = pk.meta, pk.meta.cfg
+    ci, yi = pk.const_idx, pk.carry_idx
+    s, n = meta.s, meta.n
+    n_carry = len(yi)
+
+    def kernel(const_ref, yin_ref, sin_ref, yout_ref, sout_ref, chosen_ref):
+        iota = (jax.lax.broadcasted_iota(jnp.int32, (s, LANES), 0) * LANES
+                + jax.lax.broadcasted_iota(jnp.int32, (s, LANES), 1))
+        real = iota < n
+
+        C = {name: const_ref[i] for name, i in ci.items()}
+
+        def step(k, state):
+            Y, placed_count, stopped, next_start, aff_total = state
+
+            # ---- feasibility ------------------------------------------
+            feasible = C["static_mask"] > 0.5
+            if cfg.fit_filter_on:
+                # pod-count slot: requested[PODS] + 1 > allocatable[PODS]
+                fit_ok = ~(Y[yi[f"requested{IDX_PODS}"]] + 1.0
+                           > C[f"alloc{IDX_PODS}"])
+                for j in range(meta.r):
+                    if j == IDX_PODS:
+                        continue
+                    rv = meta.req_vec[j]
+                    if cfg.dra_shared_colocate and meta.shared_req_vec[j]:
+                        rvj = rv + jnp.where(placed_count == 0,
+                                             meta.shared_req_vec[j], 0.0)
+                        fit_ok &= ~(rvj > C[f"alloc{j}"]
+                                    - Y[yi[f"requested{j}"]])
+                    elif rv > 0:
+                        fit_ok &= ~(rv > C[f"alloc{j}"]
+                                    - Y[yi[f"requested{j}"]])
+                feasible &= fit_ok
+            if cfg.clone_has_ports:
+                feasible &= ~(Y[yi["placed"]] > 0)
+            if cfg.volume_filter_on:
+                feasible &= C["volume_mask"] > 0.5
+            if cfg.volume_self_conflict:
+                feasible &= ~(Y[yi["placed"]] > 0)
+            if cfg.rwop_self_conflict:
+                feasible &= placed_count == 0
+            if cfg.dra_shared_colocate:
+                feasible &= (Y[yi["placed"]] > 0) | (placed_count == 0)
+
+            if cfg.spread_hard_n > 0:
+                violated = jnp.zeros((s, LANES), dtype=bool)
+                for c in range(meta.ch):
+                    cnt = Y[yi[f"sh_cnt{c}"]]
+                    countable = C[f"sh_countable{c}"] > 0.5
+                    min_match = jnp.min(jnp.where(countable, cnt, _BIG))
+                    if meta.sh_domnum[c] < meta.sh_mindom[c]:
+                        min_match = 0.0
+                    has_key = C[f"sh_dom{c}"] >= 0
+                    skew = cnt + (1.0 if meta.sh_self[c] else 0.0) - min_match
+                    violated |= (skew > meta.sh_skew[c]) & has_key
+                feasible &= ~((C["sh_missing"] > 0.5) | violated)
+
+            if cfg.ipa_filter_on:
+                if cfg.ipa_num_aff > 0:
+                    pods_exist = jnp.ones((s, LANES), dtype=bool)
+                    all_keys = jnp.ones((s, LANES), dtype=bool)
+                    for gi in range(meta.g):
+                        if not meta.ghas_aff[gi]:
+                            continue
+                        has_key = C[f"ipa_dom{gi}"] >= 0
+                        tot = C[f"ipa_aff_scnt{gi}"] + Y[yi[f"aff_cnt{gi}"]]
+                        pods_exist &= has_key & (tot > 0)
+                        all_keys &= has_key
+                    if cfg.ipa_escape_allowed and cfg.ipa_static_empty:
+                        escape = all_keys & (aff_total == 0)
+                        aff_ok = pods_exist | escape
+                    else:
+                        aff_ok = pods_exist
+                else:
+                    aff_ok = jnp.ones((s, LANES), dtype=bool)
+                if cfg.ipa_num_anti > 0:
+                    anti_fail = jnp.zeros((s, LANES), dtype=bool)
+                    eanti_dyn = jnp.zeros((s, LANES), dtype=bool)
+                    for gi in range(meta.g):
+                        if not meta.ghas_anti[gi]:
+                            continue
+                        has_key = C[f"ipa_dom{gi}"] >= 0
+                        dyn = Y[yi[f"anti_cnt{gi}"]]
+                        anti_fail |= has_key & \
+                            (C[f"ipa_anti_scnt{gi}"] + dyn > 0)
+                        eanti_dyn |= has_key & (dyn > 0)
+                else:
+                    anti_fail = jnp.zeros((s, LANES), dtype=bool)
+                    eanti_dyn = jnp.zeros((s, LANES), dtype=bool)
+                eanti_fail = (C["ipa_eanti_static"] > 0.5) | eanti_dyn
+                feasible &= aff_ok & ~anti_fail & ~eanti_fail
+
+            any_feasible = jnp.any(feasible)
+
+            # ---- sampling (numFeasibleNodesToFind emulation) ----------
+            scorable = feasible
+            new_next_start = next_start
+            if cfg.sample_k > 0:
+                start = next_start.astype(jnp.int32)
+                rank = jnp.where(real, (iota - start) % n, n)
+                kk = min(cfg.sample_k, n)
+
+                def bs_body(_, lo_hi):
+                    lo, hi = lo_hi
+                    mid = (lo + hi) // 2
+                    cnt = jnp.sum((feasible & (rank <= mid))
+                                  .astype(jnp.int32))
+                    return jnp.where(cnt >= kk, lo, mid + 1), \
+                        jnp.where(cnt >= kk, mid, hi)
+
+                iters = max(1, int(np.ceil(np.log2(max(n, 2)))) + 1)
+                lo, hi = jax.lax.fori_loop(
+                    0, iters, bs_body,
+                    (jnp.asarray(0, jnp.int32), jnp.asarray(n - 1, jnp.int32)))
+                threshold = hi
+                scorable = feasible & (rank <= threshold)
+                processed = threshold + 1
+                new_next_start = ((start + processed) % n).astype(jnp.float32)
+
+            # ---- scores ----------------------------------------------
+            total = jnp.zeros((s, LANES), dtype=jnp.float32)
+            w = sim._weight(cfg, "NodeResourcesFit")
+            if w:
+                acc = jnp.zeros((s, LANES), dtype=jnp.float32)
+                wsum_n = jnp.zeros((s, LANES), dtype=jnp.float32)
+                for k2, j in enumerate(cfg.fit_idx):
+                    alloc = C[f"alloc{j}"]
+                    if cfg.fit_nz[k2]:
+                        req = Y[yi["nonzero0" if j == IDX_CPU else "nonzero1"]]
+                    else:
+                        req = Y[yi[f"requested{j}"]]
+                    req = req + meta.fit_req[k2]
+                    if cfg.fit_strategy_type == "MostAllocated":
+                        per = jnp.where(alloc > 0,
+                                        _floor_div(jnp.minimum(req, alloc)
+                                                   * 100.0, alloc), 0.0)
+                    else:
+                        per = jnp.where(req > alloc, 0.0,
+                                        _floor_div((alloc - req) * 100.0,
+                                                   alloc))
+                        per = jnp.where(alloc > 0, per, 0.0)
+                    acc = acc + per * meta.fit_w[k2]
+                    # resources with alloc==0 drop their weight per node
+                    wsum_n = wsum_n + jnp.where(alloc > 0, meta.fit_w[k2], 0.0)
+                score = jnp.where(wsum_n > 0, _floor_div(acc, wsum_n), 0.0)
+                total = total + w * jnp.where(scorable, score, 0.0)
+
+            w = sim._weight(cfg, "NodeResourcesBalancedAllocation")
+            if w:
+                fracs = []
+                valids = []
+                for k2, j in enumerate(cfg.bal_idx):
+                    alloc = C[f"alloc{j}"]
+                    req = Y[yi[f"requested{j}"]] + meta.bal_req[k2]
+                    valids.append(alloc > 0)
+                    fracs.append(jnp.where(
+                        valids[-1],
+                        jnp.minimum(req / jnp.maximum(alloc, 1e-30), 1.0),
+                        0.0))
+                count = sum(v.astype(jnp.float32) for v in valids)
+                mean = sum(fracs) / jnp.maximum(count, 1.0)
+                var = sum(jnp.where(v, (fr - mean) ** 2, 0.0)
+                          for v, fr in zip(valids, fracs)) \
+                    / jnp.maximum(count, 1.0)
+                std = jnp.where(count >= 2, jnp.sqrt(var), 0.0)
+                score = jnp.trunc((1.0 - std) * 100.0)
+                total = total + w * jnp.where(scorable, score, 0.0)
+
+            def default_normalize(raw, reverse):
+                max_s = jnp.max(jnp.where(scorable, raw, 0.0))
+                scaled = jnp.where(
+                    max_s > 0,
+                    jnp.floor(100.0 * raw / jnp.where(max_s > 0, max_s, 1.0)),
+                    raw)
+                if reverse:
+                    scaled = jnp.where(max_s > 0, 100.0 - scaled, 100.0)
+                return jnp.where(scorable, scaled, 0.0)
+
+            w = sim._weight(cfg, "TaintToleration")
+            if w:
+                total = total + w * default_normalize(C["taint_raw"], True)
+            w = sim._weight(cfg, "NodeAffinity")
+            if w and cfg.na_active:
+                total = total + w * default_normalize(C["na_raw"], False)
+            w = sim._weight(cfg, "ImageLocality")
+            if w:
+                total = total + w * jnp.where(scorable, C["il_score"], 0.0)
+
+            w = sim._weight(cfg, "InterPodAffinity")
+            if w and cfg.ipa_score_active:
+                raw = C["ipa_static_pref"] if meta.has_static_pref \
+                    else jnp.zeros((s, LANES), dtype=jnp.float32)
+                if cfg.ipa_num_pref > 0:
+                    for gi in range(meta.g):
+                        raw = raw + jnp.where(C[f"ipa_dom{gi}"] >= 0,
+                                              Y[yi[f"pref_cnt{gi}"]], 0.0)
+                max_s = jnp.max(jnp.where(scorable, raw, -jnp.inf))
+                min_s = jnp.min(jnp.where(scorable, raw, jnp.inf))
+                diff = max_s - min_s
+                norm = jnp.where(
+                    diff > 0,
+                    jnp.floor(100.0 * (raw - min_s)
+                              / jnp.where(diff > 0, diff, 1.0)), 0.0)
+                total = total + w * jnp.where(scorable, norm, 0.0)
+
+            # ---- host selection (argmax, lowest index wins) ----------
+            keyed = jnp.where(scorable, total, -1.0)
+            gmax = jnp.max(keyed)
+            cand = jnp.where((keyed == gmax) & real, iota, n)
+            chosen = jnp.min(cand).astype(jnp.int32)
+            chosen = jnp.where(chosen >= n, 0, chosen)
+
+            place = any_feasible & ~(stopped > 0.5)
+            gate = place.astype(jnp.float32)
+            onehot = ((iota == chosen) & real).astype(jnp.float32) * gate
+
+            # ---- commit ----------------------------------------------
+            Y2 = list(Y)
+            for j in range(meta.r):
+                rv = meta.req_vec[j]
+                if cfg.dra_shared_colocate and meta.shared_req_vec[j]:
+                    rvj = rv + jnp.where(placed_count == 0,
+                                         meta.shared_req_vec[j], 0.0)
+                    Y2[yi[f"requested{j}"]] = Y[yi[f"requested{j}"]] \
+                        + onehot * rvj
+                elif rv != 0.0:
+                    Y2[yi[f"requested{j}"]] = Y[yi[f"requested{j}"]] \
+                        + onehot * rv
+            if meta.req_nonzero[0]:
+                Y2[yi["nonzero0"]] = Y[yi["nonzero0"]] \
+                    + onehot * meta.req_nonzero[0]
+            if meta.req_nonzero[1]:
+                Y2[yi["nonzero1"]] = Y[yi["nonzero1"]] \
+                    + onehot * meta.req_nonzero[1]
+            Y2[yi["placed"]] = Y[yi["placed"]] + onehot
+
+            if cfg.spread_hard_n > 0:
+                for c in range(meta.ch):
+                    if not meta.sh_self[c]:
+                        continue
+                    dom = C[f"sh_dom{c}"]
+                    dom_ch = jnp.sum(onehot * dom)
+                    countable_ch = jnp.sum(onehot * C[f"sh_countable{c}"])
+                    inc = countable_ch * gate
+                    hit = (dom == dom_ch) & (dom >= 0)
+                    Y2[yi[f"sh_cnt{c}"]] = Y[yi[f"sh_cnt{c}"]] \
+                        + hit.astype(jnp.float32) * inc
+
+            new_aff_total = aff_total
+            if cfg.ipa_num_aff > 0 or cfg.ipa_num_anti > 0 \
+                    or cfg.ipa_num_pref > 0:
+                for gi in range(meta.g):
+                    dom = C[f"ipa_dom{gi}"]
+                    dom_ch = jnp.sum(onehot * dom) + jnp.where(
+                        jnp.sum(onehot) > 0, 0.0, -1.0)
+                    valid = (dom_ch >= 0).astype(jnp.float32)
+                    hit = ((dom == dom_ch) & (dom >= 0)).astype(jnp.float32)
+                    if cfg.ipa_num_aff > 0 and meta.aff_ginc[gi]:
+                        inc = meta.aff_ginc[gi] * valid * gate
+                        Y2[yi[f"aff_cnt{gi}"]] = Y[yi[f"aff_cnt{gi}"]] \
+                            + hit * inc
+                        new_aff_total = new_aff_total + inc
+                    if cfg.ipa_num_anti > 0 and meta.anti_ginc[gi]:
+                        inc = meta.anti_ginc[gi] * valid * gate
+                        Y2[yi[f"anti_cnt{gi}"]] = Y[yi[f"anti_cnt{gi}"]] \
+                            + hit * inc
+                    if cfg.ipa_num_pref > 0 and meta.pref_gw[gi]:
+                        inc = meta.pref_gw[gi] * valid * gate
+                        Y2[yi[f"pref_cnt{gi}"]] = Y[yi[f"pref_cnt{gi}"]] \
+                            + hit * inc
+
+            chosen_ref[pl.ds(k, 1), :] = jnp.where(
+                place, chosen, -1).astype(jnp.int32).reshape(1, 1)
+
+            new_stopped = jnp.maximum(stopped,
+                                      (~any_feasible).astype(jnp.float32))
+            keep = stopped > 0.5
+            next_start_out = jnp.where(keep, next_start, new_next_start)
+            return (tuple(Y2),
+                    placed_count + gate,
+                    new_stopped,
+                    next_start_out,
+                    new_aff_total)
+
+        Y0 = tuple(yin_ref[i] for i in range(n_carry))
+        state = (Y0, sin_ref[0, 0], sin_ref[0, 1], sin_ref[0, 2],
+                 sin_ref[0, 3])
+        Yf, pc, st, ns, at = jax.lax.fori_loop(0, k_steps, step, state)
+        for i in range(n_carry):
+            yout_ref[i] = Yf[i]
+        sout_ref[0, 0] = pc
+        sout_ref[0, 1] = st
+        sout_ref[0, 2] = ns
+        sout_ref[0, 3] = at
+
+    return kernel
+
+
+@functools.lru_cache(maxsize=64)
+def _compiled_call(pk: _Packing, k_steps: int, interpret: bool):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    meta = pk.meta
+    kernel = _build_kernel(pk, k_steps)
+    n_const = len(pk.const_idx)
+    n_carry = len(pk.carry_idx)
+
+    out_shape = [
+        jax.ShapeDtypeStruct((n_carry, meta.s, LANES), jnp.float32),
+        jax.ShapeDtypeStruct((1, 4), jnp.float32),
+        jax.ShapeDtypeStruct((k_steps, 1), jnp.int32),
+    ]
+    call = pl.pallas_call(
+        kernel,
+        out_shape=out_shape,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 4), memory_space=pltpu.SMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 4), memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        interpret=interpret,
+    )
+    return jax.jit(call)
+
+
+# Set True after a runtime failure/mismatch: disables the kernel process-wide
+# (the XLA scan is always a correct fallback).
+_runtime_disabled = False
+# KernelMetas whose 48-step cross-check already passed in this process.
+_verified_metas: set = set()
+
+
+class FusedRunner:
+    """Drives the fused kernel with the standard consts/Carry interface."""
+
+    def __init__(self, cfg: sim.StaticConfig, pb, consts,
+                 interpret: Optional[bool] = None):
+        import jax
+        self.pk = _pack_meta(cfg, pb, consts)
+        self.const_stack = None
+        self._consts = consts
+        if interpret is None:
+            # Real Mosaic compile only on TPU-like backends; emulate elsewhere.
+            interpret = jax.default_backend() == "cpu"
+        self.interpret = interpret
+
+    def pack(self, carry: sim.Carry):
+        """Carry -> (planes, scalars) device state for run_packed."""
+        import jax.numpy as jnp
+        planes, scalars = _pack_carry(self.pk, carry)
+        return jnp.asarray(planes), jnp.asarray(scalars)
+
+    def unpack(self, state, template: sim.Carry) -> sim.Carry:
+        return _unpack_carry(self.pk, state[0], state[1], template)
+
+    def run_packed(self, state, k_steps: int):
+        """One fused chunk on packed device state; no carry round-trip.
+        Returns (new_state, chosen[k], stopped)."""
+        import jax.numpy as jnp
+        if self.const_stack is None:
+            self.const_stack = jnp.asarray(_pack_consts(self.pk, self._consts))
+        call = _compiled_call(self.pk, k_steps, self.interpret)
+        yout, sout, chosen = call(self.const_stack, state[0], state[1])
+        sc = np.asarray(sout)
+        return (yout, sout), np.asarray(chosen)[:, 0], bool(round(sc[0, 1]))
+
+    def run_chunk(self, carry: sim.Carry, k_steps: int):
+        state, chosen, _stopped = self.run_packed(self.pack(carry), k_steps)
+        return self.unpack(state, carry), chosen
+
+
+def make_runner(cfg: sim.StaticConfig, pb, consts,
+                verify_against=None) -> Optional[FusedRunner]:
+    """Build a runner when the config is kernel-eligible.
+
+    verify_against: optional (consts, carry) pair — runs a short solve prefix
+    through BOTH the kernel and the XLA step and compares placements; any
+    divergence (or compile failure) disables the kernel for the process.
+    This guards against platform-lowering differences without giving up the
+    fallback guarantee."""
+    global _runtime_disabled
+    if _runtime_disabled or not eligible(cfg, pb):
+        return None
+    try:
+        runner = FusedRunner(cfg, pb, consts)
+        key = (runner.pk.meta, runner.interpret)
+        if verify_against is not None and key not in _verified_metas:
+            v_consts, v_carry = verify_against
+            steps = 48
+            _f_carry, f_chosen = runner.run_chunk(v_carry, steps)
+            run_chunk = sim._chunk_runner()
+            _x_carry, x_chosen = run_chunk(cfg, v_consts, v_carry, steps)
+            x_chosen = np.asarray(x_chosen)
+            if not np.array_equal(f_chosen, x_chosen):
+                _runtime_disabled = True
+                return None
+            _verified_metas.add(key)
+        return runner
+    except Exception:
+        _runtime_disabled = True
+        return None

@@ -663,13 +663,45 @@ def solve(pb: enc.EncodedProblem, max_limit: int = 0,
     # trimmed to the budget afterwards.
     chunk_size = min(chunk_size, budget)
 
+    # The fused Pallas kernel runs whole chunks in one device kernel when the
+    # config allows; its first 48 steps are cross-checked against the XLA
+    # step and any divergence or compile/runtime failure falls back
+    # permanently.  Between fused chunks the carry stays packed on device —
+    # only the chosen indices and the stop flag cross to the host.
+    from . import fused
+    fused_runner = fused.make_runner(
+        cfg, pb, consts,
+        verify_against=(consts, carry) if budget > 64 else None)
+
     placements: List[int] = []
+    fused_state = None
     while len(placements) < budget:
-        carry, chosen = run_chunk(cfg, consts, carry, chunk_size)
+        if fused_runner is not None:
+            try:
+                if fused_state is None:
+                    fused_state = fused_runner.pack(carry)
+                fused_state, chosen, stopped = fused_runner.run_packed(
+                    fused_state, chunk_size)
+            except Exception:
+                # Lazy Mosaic compile/runtime failure: fall back to XLA for
+                # this and every later solve in the process.  fused_state
+                # still holds the last COMPLETED chunk's carry — recover it
+                # so the XLA loop resumes where the kernel left off.
+                fused._runtime_disabled = True
+                if fused_state is not None:
+                    carry = fused_runner.unpack(fused_state, carry)
+                fused_runner = None
+                fused_state = None
+                continue
+        else:
+            carry, chosen = run_chunk(cfg, consts, carry, chunk_size)
+            stopped = bool(np.asarray(carry.stopped))
         chosen = np.asarray(chosen)
         placements.extend(chosen[chosen >= 0].tolist())
-        if bool(np.asarray(carry.stopped)):
+        if stopped:
             break
+    if fused_state is not None:
+        carry = fused_runner.unpack(fused_state, carry)
     placements = placements[:budget]
     placed = len(placements)
     stopped = bool(np.asarray(carry.stopped))

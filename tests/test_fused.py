@@ -1,0 +1,197 @@
+"""Differential tests: fused Pallas kernel vs the XLA scan step.
+
+Runs the kernel in interpreter mode (no TPU needed) and requires bit-identical
+placement sequences, stop messages, and carried state against engine.simulator
+solves with the kernel disabled.  On real TPU hardware the same guarantee is
+enforced at runtime by make_runner's 48-step cross-check.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from cluster_capacity_tpu.engine import encode as enc
+from cluster_capacity_tpu.engine import fused
+from cluster_capacity_tpu.engine import simulator as sim
+from cluster_capacity_tpu.models.podspec import default_pod
+from cluster_capacity_tpu.models.snapshot import ClusterSnapshot
+from cluster_capacity_tpu.utils.config import SchedulerProfile
+
+
+def _nodes(n, seed=0, zones=4, taints=False):
+    rng = np.random.RandomState(seed)
+    out = []
+    for i in range(n):
+        node = {
+            "metadata": {"name": f"node-{i:04d}",
+                         "labels": {"kubernetes.io/hostname": f"node-{i:04d}",
+                                    "topology.kubernetes.io/zone": f"z{i % zones}"}},
+            "spec": {},
+            "status": {"allocatable": {
+                "cpu": f"{int(rng.choice([2000, 4000, 8000]))}m",
+                "memory": str(int(rng.choice([4, 8, 16])) * 1024 ** 3),
+                "pods": "32"}},
+        }
+        if taints and i % 3 == 0:
+            node["spec"]["taints"] = [{"key": "dedicated", "value": "x",
+                                       "effect": "PreferNoSchedule"}]
+        out.append(node)
+    return out
+
+
+def _solve_both(nodes, pod, profile=None, max_limit=0, existing=None):
+    """Solve with the fused kernel forced on, then with it off; compare."""
+    profile = profile or SchedulerProfile()
+    snap = ClusterSnapshot.from_objects(nodes, pods=existing or [])
+    pb = enc.encode_problem(snap, default_pod(pod), profile)
+    cfg = sim.static_config(pb)
+
+    os.environ["CC_TPU_FUSED"] = "1"
+    fused._runtime_disabled = False
+    try:
+        assert fused.eligible(cfg, pb), "scenario must be kernel-eligible"
+        r_fused = sim.solve(pb, max_limit=max_limit, chunk_size=128)
+        # guard against a vacuous pass: the cross-check silently falling
+        # back to XLA would make the comparison XLA-vs-XLA
+        assert not fused._runtime_disabled, \
+            "kernel diverged from the XLA step (cross-check fallback fired)"
+    finally:
+        os.environ["CC_TPU_FUSED"] = "0"
+    r_xla = sim.solve(pb, max_limit=max_limit, chunk_size=128)
+    os.environ.pop("CC_TPU_FUSED", None)
+
+    assert r_fused.placements == r_xla.placements
+    assert r_fused.fail_type == r_xla.fail_type
+    assert r_fused.fail_message == r_xla.fail_message
+    return r_fused
+
+
+def test_fit_only():
+    pod = {"metadata": {"name": "p"}, "spec": {"containers": [
+        {"name": "c", "resources": {"requests": {"cpu": "700m",
+                                                 "memory": "1Gi"}}}]}}
+    r = _solve_both(_nodes(40), pod)
+    assert r.placed_count > 0
+
+
+def test_spread_hard():
+    pod = {"metadata": {"name": "p", "labels": {"app": "web"}}, "spec": {
+        "containers": [{"name": "c", "resources": {
+            "requests": {"cpu": "500m", "memory": "1Gi"}}}],
+        "topologySpreadConstraints": [{
+            "maxSkew": 2, "topologyKey": "topology.kubernetes.io/zone",
+            "whenUnsatisfiable": "DoNotSchedule",
+            "labelSelector": {"matchLabels": {"app": "web"}}}]}}
+    r = _solve_both(_nodes(50, zones=5), pod)
+    assert r.placed_count > 0
+
+
+def test_spread_hard_hostname_and_zone():
+    pod = {"metadata": {"name": "p", "labels": {"app": "db"}}, "spec": {
+        "containers": [{"name": "c", "resources": {
+            "requests": {"cpu": "300m"}}}],
+        "topologySpreadConstraints": [
+            {"maxSkew": 1, "topologyKey": "kubernetes.io/hostname",
+             "whenUnsatisfiable": "DoNotSchedule",
+             "labelSelector": {"matchLabels": {"app": "db"}}},
+            {"maxSkew": 1, "topologyKey": "topology.kubernetes.io/zone",
+             "whenUnsatisfiable": "DoNotSchedule",
+             "labelSelector": {"matchLabels": {"app": "db"}}}]}}
+    _solve_both(_nodes(24, zones=3), pod)
+
+
+def test_taints_and_sampling():
+    pod = {"metadata": {"name": "p"}, "spec": {"containers": [
+        {"name": "c", "resources": {"requests": {"cpu": "900m"}}}]}}
+    profile = SchedulerProfile()
+    profile.percentage_of_nodes_to_score = 40
+    r = _solve_both(_nodes(120, taints=True), pod, profile=profile)
+    assert r.placed_count > 0
+
+
+def test_inter_pod_affinity_colocate():
+    pod = {"metadata": {"name": "p", "labels": {"app": "a"}}, "spec": {
+        "containers": [{"name": "c", "resources": {
+            "requests": {"cpu": "400m"}}}],
+        "affinity": {"podAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": [{
+                "topologyKey": "topology.kubernetes.io/zone",
+                "labelSelector": {"matchLabels": {"app": "a"}}}]}}}}
+    r = _solve_both(_nodes(30, zones=3), pod)
+    zones = {i % 3 for i in r.placements}
+    assert len(zones) == 1   # colocated in one zone
+
+
+def test_anti_affinity_one_per_zone():
+    pod = {"metadata": {"name": "p", "labels": {"app": "b"}}, "spec": {
+        "containers": [{"name": "c", "resources": {
+            "requests": {"cpu": "100m"}}}],
+        "affinity": {"podAntiAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": [{
+                "topologyKey": "topology.kubernetes.io/zone",
+                "labelSelector": {"matchLabels": {"app": "b"}}}]}}}}
+    r = _solve_both(_nodes(20, zones=4), pod)
+    assert r.placed_count == 4   # one per zone
+
+
+def test_preferred_affinity_scoring():
+    existing = [{"metadata": {"name": "seed", "labels": {"tier": "cache"},
+                              "namespace": "default"},
+                 "spec": {"nodeName": "node-0002", "containers": [
+                     {"name": "c", "resources": {
+                         "requests": {"cpu": "100m"}}}]}}]
+    pod = {"metadata": {"name": "p", "labels": {"app": "c"}}, "spec": {
+        "containers": [{"name": "c", "resources": {
+            "requests": {"cpu": "600m"}}}],
+        "affinity": {"podAffinity": {
+            "preferredDuringSchedulingIgnoredDuringExecution": [{
+                "weight": 50, "podAffinityTerm": {
+                    "topologyKey": "topology.kubernetes.io/zone",
+                    "labelSelector": {"matchLabels": {"tier": "cache"}}}}]}}}}
+    _solve_both(_nodes(16, zones=4), pod, existing=existing)
+
+
+def test_max_limit_and_ports():
+    pod = {"metadata": {"name": "p"}, "spec": {"containers": [
+        {"name": "c", "ports": [{"hostPort": 8080}],
+         "resources": {"requests": {"cpu": "100m"}}}]}}
+    r = _solve_both(_nodes(12), pod)
+    assert r.placed_count == 12   # one per node (host port conflict)
+    r2 = _solve_both(_nodes(12), pod, max_limit=5)
+    assert r2.placed_count == 5 and r2.fail_type == sim.FAIL_LIMIT_REACHED
+
+
+def test_most_allocated_strategy():
+    profile = SchedulerProfile()
+    profile.fit_strategy.type = "MostAllocated"
+    pod = {"metadata": {"name": "p"}, "spec": {"containers": [
+        {"name": "c", "resources": {"requests": {"cpu": "500m",
+                                                 "memory": "512Mi"}}}]}}
+    _solve_both(_nodes(25), pod, profile=profile)
+
+
+def test_runtime_mismatch_disables(monkeypatch):
+    """A divergent kernel must be rejected by the 48-step cross-check."""
+    pod = {"metadata": {"name": "p"}, "spec": {"containers": [
+        {"name": "c", "resources": {"requests": {"cpu": "100m"}}}]}}
+    snap = ClusterSnapshot.from_objects(_nodes(30))
+    pb = enc.encode_problem(snap, default_pod(pod), SchedulerProfile())
+    cfg = sim.static_config(pb)
+    consts = sim.build_consts(pb)
+    carry = sim._init_carry(pb, consts, 0)
+
+    class Bad(fused.FusedRunner):
+        def run_chunk(self, c, k):
+            nc, chosen = super().run_chunk(c, k)
+            chosen = chosen.copy()
+            if len(chosen):
+                chosen[0] = (chosen[0] + 1) % 30
+            return nc, chosen
+
+    monkeypatch.setenv("CC_TPU_FUSED", "1")
+    fused._runtime_disabled = False
+    monkeypatch.setattr(fused, "FusedRunner", Bad)
+    runner = fused.make_runner(cfg, pb, consts, verify_against=(consts, carry))
+    assert runner is None and fused._runtime_disabled
+    fused._runtime_disabled = False
