@@ -146,22 +146,9 @@ def _pack_meta(cfg: sim.StaticConfig, pb, consts) -> _Packing:
     g = ipa.node_domain.shape[0]
     ch = pb.spread_hard.node_domain.shape[0]
 
-    ghas_aff = [False] * g
-    ghas_anti = [False] * g
-    aff_ginc = [0.0] * g
-    anti_ginc = [0.0] * g
-    pref_gw = [0.0] * g
-    for t in range(ipa.num_aff_terms):
-        gi = int(ipa.aff_group[t])
-        ghas_aff[gi] = True
-        aff_ginc[gi] += float(ipa.self_aff_match[t])
-    for t in range(ipa.num_anti_terms):
-        gi = int(ipa.anti_group[t])
-        ghas_anti[gi] = True
-        anti_ginc[gi] += float(ipa.self_anti_match[t])
-    for t in range(ipa.num_pref_terms):
-        pref_gw[int(ipa.pref_group[t])] += \
-            float(ipa.self_pref_match[t]) * float(ipa.pref_weight[t])
+    from ..ops.inter_pod_affinity import group_fold
+    ghas_aff, ghas_anti, aff_ginc, anti_ginc, pref_gw = (
+        tuple(x.item() for x in arr) for arr in group_fold(ipa))
 
     sh = pb.spread_hard
     meta = KernelMeta(
@@ -342,9 +329,7 @@ def _unpack_carry(pk: _Packing, planes: np.ndarray, scalars: np.ndarray,
 # The kernel
 # ---------------------------------------------------------------------------
 
-def _floor_div(num, den):
-    import jax.numpy as jnp
-    return jnp.floor(num / jnp.maximum(den, 1e-30))
+from ..ops.node_resources_fit import _floor_div  # noqa: E402 — single source
 
 
 def _build_kernel(pk: _Packing, k_steps: int):
@@ -687,11 +672,22 @@ def _compiled_call(pk: _Packing, k_steps: int, interpret: bool):
     return jax.jit(call)
 
 
-# Set True after a runtime failure/mismatch: disables the kernel process-wide
-# (the XLA scan is always a correct fallback).
-_runtime_disabled = False
-# KernelMetas whose 48-step cross-check already passed in this process.
+# KernelMetas that failed to compile/run or diverged: disabled individually
+# (the XLA scan is always a correct fallback; other shapes keep the kernel).
+_failed_metas: set = set()
+# KernelMetas whose cross-check already passed in this process.
 _verified_metas: set = set()
+# Fused chunks actually executed (observability: bench reports this).
+STATS = {"chunks": 0}
+
+
+def mark_failed(runner: "FusedRunner", why: str) -> None:
+    """Record a runtime failure for this kernel shape and log it — silent
+    fallbacks hide both perf cliffs and real bugs."""
+    import sys
+    _failed_metas.add((runner.pk.meta, runner.interpret))
+    sys.stderr.write(f"cluster_capacity_tpu: fused kernel disabled for "
+                     f"n={runner.pk.meta.n} ({why}); using XLA scan\n")
 
 
 class FusedRunner:
@@ -726,6 +722,7 @@ class FusedRunner:
         call = _compiled_call(self.pk, k_steps, self.interpret)
         yout, sout, chosen = call(self.const_stack, state[0], state[1])
         sc = np.asarray(sout)
+        STATS["chunks"] += 1
         return (yout, sout), np.asarray(chosen)[:, 0], bool(round(sc[0, 1]))
 
     def run_chunk(self, carry: sim.Carry, k_steps: int):
@@ -737,29 +734,35 @@ def make_runner(cfg: sim.StaticConfig, pb, consts,
                 verify_against=None) -> Optional[FusedRunner]:
     """Build a runner when the config is kernel-eligible.
 
-    verify_against: optional (consts, carry) pair — runs a short solve prefix
-    through BOTH the kernel and the XLA step and compares placements; any
-    divergence (or compile failure) disables the kernel for the process.
+    verify_against: optional (consts, carry, steps) — runs a short solve
+    prefix through BOTH the kernel and the XLA step and compares placements;
+    any divergence (or compile failure) disables the kernel for this shape.
     This guards against platform-lowering differences without giving up the
     fallback guarantee."""
-    global _runtime_disabled
-    if _runtime_disabled or not eligible(cfg, pb):
+    if not eligible(cfg, pb):
         return None
+    runner = None
     try:
         runner = FusedRunner(cfg, pb, consts)
         key = (runner.pk.meta, runner.interpret)
+        if key in _failed_metas:
+            return None
         if verify_against is not None and key not in _verified_metas:
-            v_consts, v_carry = verify_against
-            steps = 48
+            v_consts, v_carry, steps = verify_against
             _f_carry, f_chosen = runner.run_chunk(v_carry, steps)
             run_chunk = sim._chunk_runner()
             _x_carry, x_chosen = run_chunk(cfg, v_consts, v_carry, steps)
             x_chosen = np.asarray(x_chosen)
             if not np.array_equal(f_chosen, x_chosen):
-                _runtime_disabled = True
+                mark_failed(runner, "cross-check divergence vs XLA step")
                 return None
             _verified_metas.add(key)
         return runner
-    except Exception:
-        _runtime_disabled = True
+    except Exception as e:                      # pragma: no cover - defensive
+        if runner is not None:
+            mark_failed(runner, f"{type(e).__name__}: {e}")
+        else:
+            import sys
+            sys.stderr.write("cluster_capacity_tpu: fused kernel packing "
+                             f"failed ({type(e).__name__}: {e})\n")
         return None

@@ -218,8 +218,12 @@ def _expand_counts(init_counts: np.ndarray, node_domain: np.ndarray) -> np.ndarr
     return np.where(node_domain >= 0, out, 0.0)
 
 
-def build_consts(pb: enc.EncodedProblem) -> Dict[str, "jax.Array"]:
-    """Move all static arrays to device once, in the profile dtype."""
+def build_consts(pb: enc.EncodedProblem,
+                 ss_dnh_min: int = 1) -> Dict[str, "jax.Array"]:
+    """Move all static arrays to device once, in the profile dtype.
+
+    ss_dnh_min pads the soft-spread one-hot's domain axis up to a group-wide
+    size so batched sweeps can stack consts across templates."""
     import jax.numpy as jnp
     dt = jnp.float64 if pb.profile.compute_dtype == "float64" else jnp.float32
     f = lambda a: jnp.asarray(a, dtype=dt)
@@ -230,7 +234,7 @@ def build_consts(pb: enc.EncodedProblem) -> Dict[str, "jax.Array"]:
     # becomes one small matmul.  Hostname rows stay zero (their size is the
     # scorable-node count — no domain structure needed).
     dom_s = ss.node_domain
-    d_nh = 1
+    d_nh = max(1, ss_dnh_min)
     for c in range(ss.num_constraints):
         if not ss.is_hostname[c] and (dom_s[c] >= 0).any():
             d_nh = max(d_nh, int(dom_s[c].max()) + 1)
@@ -244,25 +248,9 @@ def build_consts(pb: enc.EncodedProblem) -> Dict[str, "jax.Array"]:
                 nodes = np.nonzero(dom_s[c] >= 0)[0]
                 ss_onehot[c, dom_s[c][nodes], nodes] = 1.0
 
-    # Per-GROUP IPA statics: terms sharing a topologyKey read/write the same
-    # merged count row, so per-term bookkeeping folds into group sums.
-    g = ipa.node_domain.shape[0]
-    ghas_aff = np.zeros(g, dtype=bool)
-    ghas_anti = np.zeros(g, dtype=bool)
-    aff_ginc = np.zeros(g)
-    anti_ginc = np.zeros(g)
-    pref_gw = np.zeros(g)
-    for t in range(ipa.num_aff_terms):
-        gi = int(ipa.aff_group[t])
-        ghas_aff[gi] = True
-        aff_ginc[gi] += float(ipa.self_aff_match[t])
-    for t in range(ipa.num_anti_terms):
-        gi = int(ipa.anti_group[t])
-        ghas_anti[gi] = True
-        anti_ginc[gi] += float(ipa.self_anti_match[t])
-    for t in range(ipa.num_pref_terms):
-        pref_gw[int(ipa.pref_group[t])] += \
-            float(ipa.self_pref_match[t]) * float(ipa.pref_weight[t])
+    # Per-GROUP IPA statics (shared with the fused kernel's meta packing).
+    ghas_aff, ghas_anti, aff_ginc, anti_ginc, pref_gw = \
+        ipa_ops.group_fold(ipa)
 
     return {
         "allocatable": f(pb.allocatable),
@@ -664,14 +652,14 @@ def solve(pb: enc.EncodedProblem, max_limit: int = 0,
     chunk_size = min(chunk_size, budget)
 
     # The fused Pallas kernel runs whole chunks in one device kernel when the
-    # config allows; its first 48 steps are cross-checked against the XLA
-    # step and any divergence or compile/runtime failure falls back
-    # permanently.  Between fused chunks the carry stays packed on device —
-    # only the chosen indices and the stop flag cross to the host.
+    # config allows; its first min(48, budget) steps are cross-checked
+    # against the XLA step and any divergence or compile/runtime failure
+    # falls back for this kernel shape.  Between fused chunks the carry
+    # stays packed on device — only the chosen indices and the stop flag
+    # cross to the host.
     from . import fused
     fused_runner = fused.make_runner(
-        cfg, pb, consts,
-        verify_against=(consts, carry) if budget > 64 else None)
+        cfg, pb, consts, verify_against=(consts, carry, min(48, budget)))
 
     placements: List[int] = []
     fused_state = None
@@ -682,12 +670,12 @@ def solve(pb: enc.EncodedProblem, max_limit: int = 0,
                     fused_state = fused_runner.pack(carry)
                 fused_state, chosen, stopped = fused_runner.run_packed(
                     fused_state, chunk_size)
-            except Exception:
+            except Exception as e:
                 # Lazy Mosaic compile/runtime failure: fall back to XLA for
-                # this and every later solve in the process.  fused_state
-                # still holds the last COMPLETED chunk's carry — recover it
-                # so the XLA loop resumes where the kernel left off.
-                fused._runtime_disabled = True
+                # this kernel shape.  fused_state still holds the last
+                # COMPLETED chunk's carry — recover it so the XLA loop
+                # resumes where the kernel left off.
+                fused.mark_failed(fused_runner, f"{type(e).__name__}: {e}")
                 if fused_state is not None:
                     carry = fused_runner.unpack(fused_state, carry)
                 fused_runner = None

@@ -291,6 +291,64 @@ def encode(snapshot: ClusterSnapshot, pod: Mapping,
     )
 
 
+def group_fold(enc_: AffinityEncoding):
+    """Fold per-term bookkeeping into per-GROUP statics (terms sharing a
+    topologyKey read/write the same merged count row).  Returns
+    (ghas_aff, ghas_anti, aff_ginc, anti_ginc, pref_gw) numpy arrays — the
+    single source for both the XLA step consts and the fused kernel meta."""
+    g = enc_.node_domain.shape[0]
+    ghas_aff = np.zeros(g, dtype=bool)
+    ghas_anti = np.zeros(g, dtype=bool)
+    aff_ginc = np.zeros(g)
+    anti_ginc = np.zeros(g)
+    pref_gw = np.zeros(g)
+    for t in range(enc_.num_aff_terms):
+        gi = int(enc_.aff_group[t])
+        ghas_aff[gi] = True
+        aff_ginc[gi] += float(enc_.self_aff_match[t])
+    for t in range(enc_.num_anti_terms):
+        gi = int(enc_.anti_group[t])
+        ghas_anti[gi] = True
+        anti_ginc[gi] += float(enc_.self_anti_match[t])
+    for t in range(enc_.num_pref_terms):
+        pref_gw[int(enc_.pref_group[t])] += \
+            float(enc_.self_pref_match[t]) * float(enc_.pref_weight[t])
+    return ghas_aff, ghas_anti, aff_ginc, anti_ginc, pref_gw
+
+
+def pad_groups(enc_: AffinityEncoding, g_rows: int) -> AffinityEncoding:
+    """Pad the topology-group axis to g_rows with inert rows (no key on any
+    node, zero counts) so heterogeneous templates can share one vmapped
+    solve.  Term arrays keep their lengths — padded groups own no terms."""
+    cur = enc_.node_domain.shape[0]
+    if cur >= g_rows:
+        return enc_
+    pad = g_rows - cur
+    n = enc_.node_domain.shape[1]
+    d = enc_.aff_init.shape[1]
+    return AffinityEncoding(
+        num_aff_terms=enc_.num_aff_terms,
+        num_anti_terms=enc_.num_anti_terms,
+        max_domains=enc_.max_domains,
+        aff_group=enc_.aff_group, anti_group=enc_.anti_group,
+        group_keys=list(enc_.group_keys) + [""] * pad,
+        node_domain=np.concatenate([enc_.node_domain,
+                                    np.full((pad, n), -1, dtype=np.int32)]),
+        aff_init=np.concatenate([enc_.aff_init, np.zeros((pad, d))]),
+        anti_init=np.concatenate([enc_.anti_init, np.zeros((pad, d))]),
+        self_aff_match=enc_.self_aff_match,
+        self_anti_match=enc_.self_anti_match,
+        escape_allowed=enc_.escape_allowed,
+        existing_anti_static=enc_.existing_anti_static,
+        num_pref_terms=enc_.num_pref_terms,
+        pref_group=enc_.pref_group,
+        pref_weight=enc_.pref_weight,
+        self_pref_match=enc_.self_pref_match,
+        static_pref_score=enc_.static_pref_score,
+        has_any_score_terms=enc_.has_any_score_terms,
+    )
+
+
 # ---------------------------------------------------------------------------
 # Device-side kernels (dense per-node count formulation)
 #

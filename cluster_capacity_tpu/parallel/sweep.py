@@ -7,11 +7,14 @@ static masks and static score vectors stack to [B, ...] tensors, and the scan
 engine runs all B greedy simulations in lockstep on device — sharded over a
 (batch, nodes) mesh when one is provided.
 
-This fast path covers templates whose constraints are batch-uniform in shape:
-resource requests, node selectors/affinity, taints/tolerations, images, host
-ports vs existing pods (i.e. everything except per-template
-PodTopologySpread/InterPodAffinity tensors, whose domain shapes differ).
-Templates needing those fall back to the sequential engine automatically.
+Topology-constrained templates batch too: per-template PodTopologySpread and
+InterPodAffinity state is carried as per-node count tensors whose constraint/
+group axes pad to a group-wide maximum with inert always-pass rows, so
+heterogeneous spread/affinity templates (BASELINE config 3) share one
+compiled vmapped solve — bit-identical to their sequential solves
+(tests/test_sweep_batched.py).  Only clone self-conflict gates (host ports,
+inline-disk, RWOP, shared DRA claims) and pod-level rejections stay
+sequential.
 """
 
 from __future__ import annotations
@@ -29,10 +32,72 @@ from . import mesh as mesh_lib
 
 
 def _batchable(pb: enc.EncodedProblem) -> bool:
-    return (pb.spread_hard.empty and pb.spread_soft.empty and
-            not pb.ipa.active and not pb.clone_has_host_ports and
+    """Templates whose constraints can ride a vmapped group solve.  Spread
+    and inter-pod-affinity templates batch too (their per-node count tensors
+    pad to a group-wide constraint/group count with inert rows); only the
+    rare clone self-conflict gates and pod-level rejections stay sequential."""
+    return (not pb.clone_has_host_ports and
             pb.pod_level_reason is None and not pb.volume_self_conflict and
-            not pb.rwop_self_conflict)
+            not pb.rwop_self_conflict and not pb.dra_shared_colocate)
+
+
+def _group_key(pb: enc.EncodedProblem, cfg) -> tuple:
+    """Group templates that can share ONE compiled vmapped step.  Count
+    fields that padding makes uniform are normalized to any/none; everything
+    else in StaticConfig must match exactly."""
+    norm = cfg._replace(
+        spread_hard_n=0, spread_soft_n=0,
+        ipa_num_aff=0, ipa_num_anti=0, ipa_num_pref=0,
+        ipa_filter_on=False, ipa_score_active=False, na_active=False,
+        volume_filter_on=False,
+        # the lonely-pod escape statics only matter to templates with
+        # required affinity terms; others merge freely
+        ipa_escape_allowed=cfg.ipa_escape_allowed if cfg.ipa_num_aff else False,
+        ipa_static_empty=cfg.ipa_static_empty if cfg.ipa_num_aff else False,
+    )
+    return (norm, pb.req_vec.shape, pb.fit_res_idx.shape,
+            pb.balanced_res_idx.shape)
+
+
+def _pad_group(pbs: List[enc.EncodedProblem]) -> tuple:
+    """Pad every template's constraint/group axes to the group maxima.
+    Returns (padded problems, uniform StaticConfig, ss_dnh)."""
+    from ..ops import inter_pod_affinity as ipa_ops
+    from ..ops import pod_topology_spread as spread_ops
+    import dataclasses
+
+    ch = max(pb.spread_hard.node_domain.shape[0] for pb in pbs)
+    cs = max(pb.spread_soft.node_domain.shape[0] for pb in pbs)
+    g = max(pb.ipa.node_domain.shape[0] for pb in pbs)
+    dnh = max(sim._soft_nonhost_domains(pb.spread_soft) for pb in pbs)
+
+    padded = []
+    for pb in pbs:
+        padded.append(dataclasses.replace(
+            pb,
+            spread_hard=spread_ops.pad_constraints(pb.spread_hard, ch),
+            spread_soft=spread_ops.pad_constraints(pb.spread_soft, cs),
+            ipa=ipa_ops.pad_groups(pb.ipa, g)))
+
+    # Uniform step config: count gates switch on when ANY template needs the
+    # plugin — inert padded rows make it a no-op for the others.
+    cfgs = [sim.static_config(pb) for pb in padded]
+    aff_cfgs = [c for c in cfgs if c.ipa_num_aff]
+    cfg = cfgs[0]
+    cfg = cfg._replace(
+        spread_hard_n=max(c.spread_hard_n for c in cfgs),
+        spread_soft_n=max(c.spread_soft_n for c in cfgs),
+        ipa_num_aff=max(c.ipa_num_aff for c in cfgs),
+        ipa_num_anti=max(c.ipa_num_anti for c in cfgs),
+        ipa_num_pref=max(c.ipa_num_pref for c in cfgs),
+        ipa_filter_on=any(c.ipa_filter_on for c in cfgs),
+        ipa_score_active=any(c.ipa_score_active for c in cfgs),
+        na_active=any(c.na_active for c in cfgs),
+        volume_filter_on=any(c.volume_filter_on for c in cfgs),
+        ipa_escape_allowed=any(c.ipa_escape_allowed for c in aff_cfgs),
+        ipa_static_empty=any(c.ipa_static_empty for c in aff_cfgs),
+    )
+    return padded, cfg, dnh
 
 
 def sweep(snapshot: ClusterSnapshot, templates: Sequence[dict],
@@ -72,8 +137,7 @@ def sweep(snapshot: ClusterSnapshot, templates: Sequence[dict],
         if fast_path.eligible(pb) and (not max_limit or max_limit > 4096):
             rest_idx.append(i)
         elif _batchable(pb):
-            key = (sim.static_config(pb), pb.fit_res_idx.shape,
-                   pb.balanced_res_idx.shape, pb.req_vec.shape)
+            key = _group_key(pb, sim.static_config(pb))
             groups.setdefault(key, []).append(i)
         else:
             rest_idx.append(i)
@@ -98,8 +162,8 @@ def _batched_solve(pbs: List[enc.EncodedProblem], max_limit: int,
     import jax.numpy as jnp
 
     sim._ensure_x64(pbs[0].profile)
-    cfg = sim.static_config(pbs[0])
-    consts_list = [sim.build_consts(pb) for pb in pbs]
+    pbs, cfg, dnh = _pad_group(pbs)
+    consts_list = [sim.build_consts(pb, ss_dnh_min=dnh) for pb in pbs]
     carry_list = [sim._init_carry(pb, c, pb.profile.seed)
                   for pb, c in zip(pbs, consts_list)]
     consts = {k: jnp.stack([c[k] for c in consts_list])

