@@ -64,7 +64,11 @@ class EncodedProblem:
     pod: dict
     profile: SchedulerProfile
 
-    # resource axis
+    # resource axis — R here may EXCEED the snapshot's vocabulary: resources
+    # the pod requests that no node publishes become zero-allocatable
+    # virtual columns so every node reports "Insufficient <name>"
+    # (fit.go:564-660: an absent scalar resource reads as allocatable 0).
+    resource_names: List[str]      # snapshot vocabulary + missing resources
     allocatable: np.ndarray        # f[N, R]
     init_requested: np.ndarray     # f[N, R]
     init_nonzero: np.ndarray       # f[N, 2]
@@ -117,11 +121,9 @@ class EncodedProblem:
 def encode_problem(snapshot: ClusterSnapshot, pod: dict,
                    profile: SchedulerProfile) -> EncodedProblem:
     n = snapshot.num_nodes
-    r = snapshot.num_resources
 
     # --- pod request vectors ------------------------------------------------
     reqs = ps.pod_requests(pod)
-    req_vec = np.zeros(r, dtype=np.float64)
     ignored = set(profile.ignored_resources)
     ignored_groups = set(profile.ignored_resource_groups)
 
@@ -131,10 +133,33 @@ def encode_problem(snapshot: ClusterSnapshot, pod: dict,
             return False
         return name in ignored or name.split("/")[0] in ignored_groups
 
+    # Requested resources absent from the snapshot vocabulary: no node
+    # publishes them → allocatable reads as 0 everywhere (fit.go:585-600) →
+    # model them as zero-allocatable virtual columns.
+    missing = sorted(name for name, v in reqs.items()
+                     if v > 0 and not _ignored(name)
+                     and snapshot.resource_index(name) is None)
+    resource_names = list(snapshot.resource_names) + missing
+    r = len(resource_names)
+
+    def rindex(name: str):
+        j = snapshot.resource_index(name)
+        if j is None and name in missing:
+            return snapshot.num_resources + missing.index(name)
+        return j
+
+    allocatable = snapshot.allocatable
+    init_requested = snapshot.requested
+    if missing:
+        zeros = np.zeros((n, len(missing)), dtype=np.float64)
+        allocatable = np.concatenate([allocatable, zeros], axis=1)
+        init_requested = np.concatenate([init_requested, zeros], axis=1)
+
+    req_vec = np.zeros(r, dtype=np.float64)
     for name, v in reqs.items():
         if _ignored(name):
             continue
-        j = snapshot.resource_index(name)
+        j = rindex(name)
         if j is not None:
             req_vec[j] = v
     req_vec[IDX_PODS] = 1.0
@@ -293,10 +318,10 @@ def encode_problem(snapshot: ClusterSnapshot, pod: dict,
             snapshot, {"metadata": pod.get("metadata", {}), "spec": {}})
 
     # --- scan-length upper bound from the fit filter ------------------------
-    free = snapshot.allocatable - snapshot.requested
+    free = allocatable - init_requested
     per_node = np.full(n, np.inf)
-    pod_slots = np.maximum(snapshot.allocatable[:, IDX_PODS]
-                           - snapshot.requested[:, IDX_PODS], 0.0)
+    pod_slots = np.maximum(allocatable[:, IDX_PODS]
+                           - init_requested[:, IDX_PODS], 0.0)
     per_node = np.minimum(per_node, pod_slots)
     if enabled("NodeResourcesFit"):
         for j in range(r):
@@ -313,7 +338,8 @@ def encode_problem(snapshot: ClusterSnapshot, pod: dict,
 
     return EncodedProblem(
         snapshot=snapshot, pod=pod, profile=profile,
-        allocatable=snapshot.allocatable, init_requested=snapshot.requested,
+        resource_names=resource_names,
+        allocatable=allocatable, init_requested=init_requested,
         init_nonzero=snapshot.nonzero_requested,
         req_vec=req_vec, req_nonzero=req_nonzero,
         fit_res_idx=np.asarray(fit_idx or [IDX_CPU], dtype=np.int32),

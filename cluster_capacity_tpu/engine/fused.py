@@ -101,7 +101,7 @@ def eligible(cfg: sim.StaticConfig, pb) -> bool:
     n = pb.snapshot.num_nodes
     if n == 0 or n > MAX_NODES:
         return False
-    if pb.snapshot.num_resources > MAX_R:
+    if len(pb.resource_names) > MAX_R:
         return False
     if cfg.spread_hard_n > MAX_SPREAD:
         return False
@@ -141,7 +141,7 @@ class _Packing(NamedTuple):
 def _pack_meta(cfg: sim.StaticConfig, pb, consts) -> _Packing:
     n = pb.snapshot.num_nodes
     s = max(1, -(-n // LANES))
-    r = pb.snapshot.num_resources
+    r = len(pb.resource_names)
     ipa = pb.ipa
     g = ipa.node_domain.shape[0]
     ch = pb.spread_hard.node_domain.shape[0]

@@ -772,9 +772,9 @@ def diagnose(pb: enc.EncodedProblem, cfg: StaticConfig, consts,
         if too_many is not None:
             add("Too many pods", int((take & too_many).sum()))
         if insufficient is not None:
-            dra_cols = [j for j, rn in enumerate(pb.snapshot.resource_names)
+            dra_cols = [j for j, rn in enumerate(pb.resource_names)
                         if rn.startswith(DRA_RESOURCE_PREFIX)]
-            for j, rname in enumerate(pb.snapshot.resource_names):
+            for j, rname in enumerate(pb.resource_names):
                 if j in dra_cols:
                     continue
                 add(f"Insufficient {rname}",
