@@ -167,8 +167,12 @@ def encode_problem(snapshot: ClusterSnapshot, pod: dict,
     # DRA claims → device pseudo-resource requests (ops/dynamic_resources.py)
     from ..ops import dynamic_resources as dra
     dra_on = profile.filter_enabled("DynamicResources")
-    dra_enc = dra.encode(pod, snapshot.resource_claims,
-                         snapshot.resource_claim_templates) if dra_on \
+    dra_enc = dra.encode(
+        pod, snapshot.resource_claims, snapshot.resource_claim_templates,
+        device_classes=snapshot.device_classes,
+        has_shared_counters=any(
+            (rs.get("spec") or {}).get("sharedCounters")
+            for rs in snapshot.resource_slices)) if dra_on \
         else dra.DraEncoding()
     dra_missing_class = False
     shared_req_vec = np.zeros(r, dtype=np.float64)
@@ -185,6 +189,19 @@ def encode_problem(snapshot: ClusterSnapshot, pod: dict,
             dra_missing_class = True
         else:
             shared_req_vec[j] = v
+    if dra_enc.slot_requests:
+        # structured allocator (CEL selectors / adminAccess / partitionable
+        # devices): one virtual per-node column — allocatable = max clones
+        # the node's free devices support, each clone requests 1
+        slots = dra.compute_slot_columns(snapshot, dra_enc.slot_requests)
+        resource_names = resource_names + [dra.DRA_SLOTS_RESOURCE]
+        allocatable = np.concatenate(
+            [allocatable, slots[:, None]], axis=1)
+        init_requested = np.concatenate(
+            [init_requested, np.zeros((n, 1))], axis=1)
+        req_vec = np.concatenate([req_vec, [1.0]])
+        shared_req_vec = np.concatenate([shared_req_vec, [0.0]])
+        r = len(resource_names)
     cpu_nz, mem_nz = ps.pod_nonzero_cpu_mem(pod)
     req_nonzero = np.asarray([cpu_nz, mem_nz], dtype=np.float64)
 
@@ -279,8 +296,10 @@ def encode_problem(snapshot: ClusterSnapshot, pod: dict,
     # --- static scores ------------------------------------------------------
     taint_raw = taint_toleration.static_raw_score(snapshot, pod) \
         if profile.score_weight("TaintToleration") else np.zeros(n)
-    na_active = node_affinity.has_preferred_terms(pod)
-    na_raw = node_affinity.static_raw_score(snapshot, pod) \
+    na_active = node_affinity.has_preferred_terms(
+        pod, added_affinity=profile.added_affinity)
+    na_raw = node_affinity.static_raw_score(
+        snapshot, pod, added_affinity=profile.added_affinity) \
         if na_active and profile.score_weight("NodeAffinity") else np.zeros(n)
     il_score = image_locality.static_score(snapshot, pod) \
         if profile.score_weight("ImageLocality") else np.zeros(n)

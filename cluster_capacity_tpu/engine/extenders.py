@@ -35,13 +35,45 @@ class ExtenderConfig:
     url_prefix: str = ""
     filter_verb: str = ""
     prioritize_verb: str = ""
+    bind_verb: str = ""
+    preempt_verb: str = ""
     weight: int = 1
     node_cache_capable: bool = False
     ignorable: bool = False
     http_timeout_s: float = 30.0
+    # managedResources (extender.go:375-380 IsInterested): when non-empty the
+    # extender is consulted only for pods requesting one of these resources.
+    managed_resources: List[str] = field(default_factory=list)
     # test/embedding hooks: take (pod, node_names) → same payloads as HTTP
     filter_callable: Optional[Callable] = None
     prioritize_callable: Optional[Callable] = None
+    bind_callable: Optional[Callable] = None
+    preempt_callable: Optional[Callable] = None
+
+    @property
+    def is_binder(self) -> bool:
+        return bool(self.bind_verb or self.bind_callable)
+
+    @property
+    def supports_preemption(self) -> bool:
+        return bool(self.preempt_verb or self.preempt_callable)
+
+    def is_interested(self, pod: dict) -> bool:
+        """IsInterested (extender.go:364-380): empty managedResources means
+        every pod; otherwise any container requesting or limiting one of the
+        managed resource names (init containers included)."""
+        if not self.managed_resources:
+            return True
+        managed = set(self.managed_resources)
+        spec = pod.get("spec") or {}
+        containers = list(spec.get("containers") or []) + \
+            list(spec.get("initContainers") or [])
+        for c in containers:
+            res = c.get("resources") or {}
+            for kind in ("requests", "limits"):
+                if managed & set((res.get(kind) or {}).keys()):
+                    return True
+        return False
 
     def filter(self, pod: dict, node_names: List[str],
                node_objects: Optional[Dict[str, dict]] = None) -> Dict:
@@ -58,6 +90,60 @@ class ExtenderConfig:
             return []
         out = self._post(self.prioritize_verb, pod, node_names)
         return out if isinstance(out, list) else []
+
+    def bind(self, pod: dict, node_name: str) -> Dict:
+        """Bind verb (extender.go:318-341): ExtenderBindingArgs →
+        ExtenderBindingResult; a non-empty Error fails the binding."""
+        meta = pod.get("metadata") or {}
+        if self.bind_callable is not None:
+            return self.bind_callable(pod, node_name) or {}
+        args = {"PodName": meta.get("name", ""),
+                "PodNamespace": meta.get("namespace", "default"),
+                "PodUID": meta.get("uid", ""),
+                "Node": node_name}
+        req = urllib.request.Request(
+            self.url_prefix.rstrip("/") + "/" + self.bind_verb,
+            data=json.dumps(args).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=self.http_timeout_s) as r:
+            return json.loads(r.read().decode()) or {}
+
+    def process_preemption(self, pod: dict,
+                           node_to_victims: Dict[str, List[dict]]
+                           ) -> Optional[Dict[str, List[dict]]]:
+        """ProcessPreemption (extender.go:343-373): the extender returns the
+        subset of candidate nodes (with possibly-updated victim lists) it
+        accepts; None on a skipped/verbless extender."""
+        if self.preempt_callable is not None:
+            return self.preempt_callable(pod, node_to_victims)
+        if not self.preempt_verb:
+            return None
+        args = {"Pod": pod,
+                "NodeNameToVictims": {
+                    n: {"Pods": v, "NumPDBViolations": 0}
+                    for n, v in node_to_victims.items()}}
+        req = urllib.request.Request(
+            self.url_prefix.rstrip("/") + "/" + self.preempt_verb,
+            data=json.dumps(args).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=self.http_timeout_s) as r:
+            result = json.loads(r.read().decode()) or {}
+        kept = result.get("NodeNameToVictims") \
+            or result.get("NodeNameToMetaVictims")
+        if kept is None:
+            return None
+        out: Dict[str, List[dict]] = {}
+        for n, victims in kept.items():
+            if n not in node_to_victims:
+                continue
+            pods = (victims or {}).get("Pods")
+            if pods and all(isinstance(p, dict) and p.get("metadata")
+                            for p in pods):
+                out[n] = list(pods)
+            else:
+                # MetaVictims (uid-only) or absent: keep the local victims
+                out[n] = node_to_victims[n]
+        return out
 
     def _post(self, verb: str, pod: dict, node_names: List[str],
               node_objects: Optional[Dict[str, dict]] = None):
@@ -85,12 +171,57 @@ def parse_extenders(cfg: dict) -> List[ExtenderConfig]:
             url_prefix=e.get("urlPrefix", ""),
             filter_verb=e.get("filterVerb", ""),
             prioritize_verb=e.get("prioritizeVerb", ""),
+            bind_verb=e.get("bindVerb", ""),
+            preempt_verb=e.get("preemptVerb", ""),
             weight=int(e.get("weight", 1)),
             node_cache_capable=bool(e.get("nodeCacheCapable")),
             ignorable=bool(e.get("ignorable")),
             http_timeout_s=_parse_duration(e.get("httpTimeout")),
+            managed_resources=[str(m.get("name", m) if isinstance(m, dict)
+                                   else m)
+                               for m in e.get("managedResources") or []],
         ))
     return out
+
+
+def run_preemption_chain(extenders, pod: dict,
+                         node_to_victims: Dict[str, List[dict]]
+                         ) -> Dict[str, List[dict]]:
+    """Consult every preemption-supporting, interested extender in turn,
+    intersecting the candidate map (Evaluator.callExtenders,
+    preemption.go:341-402)."""
+    current = dict(node_to_victims)
+    for ext in extenders or []:
+        if not ext.supports_preemption or not ext.is_interested(pod):
+            continue
+        try:
+            result = ext.process_preemption(pod, current)
+            if result is not None:
+                # intersection semantics regardless of transport: an
+                # extender can only REMOVE candidates or update their
+                # victim lists, never resurrect or invent nodes
+                current = {n: (v if isinstance(v, list) else current[n])
+                           for n, v in result.items() if n in current}
+            if not current:
+                break
+        except Exception:
+            if not ext.ignorable:
+                raise
+    return current
+
+
+def run_bind(extenders, pod: dict, node_name: str) -> None:
+    """Delegate binding to the first interested binder extender
+    (schedule_one.go extendersBinding): a returned Error fails the bind."""
+    for ext in extenders or []:
+        if not ext.is_binder or not ext.is_interested(pod):
+            continue
+        result = ext.bind(pod, node_name)
+        if result.get("Error"):
+            raise RuntimeError(
+                f"extender bind failed for node {node_name}: "
+                f"{result['Error']}")
+        return
 
 
 def _parse_duration(v) -> float:
@@ -134,6 +265,8 @@ def run_filter_chain(extenders, pod: dict, node_names: List[str],
     names = list(node_names)
     for ext in extenders:
         if not (ext.filter_verb or ext.filter_callable):
+            continue
+        if not ext.is_interested(pod):
             continue
         try:
             verdict = ext.filter(pod, names, node_objects)
@@ -205,6 +338,8 @@ def solve_with_extenders(pb: enc.EncodedProblem,
         for ext in extenders:
             if not (ext.prioritize_verb or ext.prioritize_callable):
                 continue
+            if not ext.is_interested(pb.pod):
+                continue
             try:
                 for hp in ext.prioritize(pb.pod, feasible_names):
                     nm = hp.get("Host")
@@ -220,6 +355,10 @@ def solve_with_extenders(pb: enc.EncodedProblem,
         # -inf sentinel: extender scores may push totals negative
         keyed = np.where(feasible, total, -np.inf)
         chosen = int(np.argmax(keyed))     # first max → lowest index ties
+        # Bind verb: an interested binder extender replaces the default
+        # binder for this pod (extender.go:318-341); a bind error fails the
+        # simulation loudly rather than retrying forever.
+        run_bind(extenders, pb.pod, names[chosen])
         carry = apply(cfg, consts, carry, jnp.asarray(chosen, jnp.int32))
         placements.append(chosen)
 

@@ -129,13 +129,16 @@ def _pod_start_time(pod: Mapping) -> str:
 
 def evaluate(snapshot: ClusterSnapshot, state_pods: List[List[dict]],
              pod: Mapping, profile: SchedulerProfile,
-             node_ok=None) -> PreemptionOutcome:
+             node_ok=None, extenders=None) -> PreemptionOutcome:
     """Run the preemption dry-run over every candidate node.
 
     `state_pods` is the CURRENT per-node pod roster (snapshot pods + clones
     placed so far); victims are only selected among pods with lower priority
     than the incoming pod.  `node_ok(node_name) -> bool` lets the caller veto
-    candidates the in-tree filters can't see (extender-filtered nodes)."""
+    candidates the in-tree filters can't see (extender-filtered nodes).
+    `extenders` that support preemption are consulted with the candidate
+    victim map before pickOneNode (Evaluator.callExtenders,
+    preemption.go:341-402 + extender.go:343-373)."""
     incoming_priority = resolve_priority(pod, snapshot.priority_classes)
     if ((pod.get("spec") or {}).get("preemptionPolicy")) == "Never":
         return PreemptionOutcome(None, [], {
@@ -196,6 +199,15 @@ def evaluate(snapshot: ClusterSnapshot, state_pods: List[List[dict]],
         state.pods_by_node[i] = saved
         candidates.append((i, victims, _pdb_violations(victims, pdbs)))
 
+    if candidates and extenders:
+        from .extenders import run_preemption_chain
+        name_to_idx = {n: i for i, n in enumerate(snapshot.node_names)}
+        victim_map = {snapshot.node_names[i]: v for i, v, _ in candidates}
+        kept = run_preemption_chain(extenders, dict(pod), victim_map)
+        candidates = [
+            (name_to_idx[n], v, _pdb_violations(v, pdbs))
+            for n, v in kept.items()]
+        candidates.sort(key=lambda c: c[0])     # restore node order
     if not candidates:
         return PreemptionOutcome(None, [], message_counts)
 

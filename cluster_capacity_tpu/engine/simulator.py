@@ -611,11 +611,15 @@ def _ensure_x64(profile):
 
 
 def solve(pb: enc.EncodedProblem, max_limit: int = 0,
-          chunk_size: int = 1024) -> SolveResult:
+          chunk_size: int = 1024, mesh=None) -> SolveResult:
     """Run the greedy placement loop to completion.
 
     The scan runs in fixed-size chunks of a jitted `lax.scan`; chunks repeat
-    until the carry reports a stop or the step budget is exhausted."""
+    until the carry reports a stop or the step budget is exhausted.
+
+    With `mesh` given, consts and carry shard over it (node axis across
+    devices, multi-host included) and XLA inserts the ICI/DCN collectives;
+    placements are identical to the unsharded solve."""
     import jax
     import numpy as np
 
@@ -640,6 +644,11 @@ def solve(pb: enc.EncodedProblem, max_limit: int = 0,
     cfg = static_config(pb)
     consts = build_consts(pb)
     carry = _init_carry(pb, consts, pb.profile.seed)
+    host_consts = consts
+    if mesh is not None:
+        from ..parallel import mesh as mesh_lib
+        consts = mesh_lib.shard_consts(mesh, consts)
+        carry = mesh_lib.shard_carry(mesh, carry)
     run_chunk = _chunk_runner()
 
     budget = pb.max_steps_hint + 1
@@ -658,8 +667,10 @@ def solve(pb: enc.EncodedProblem, max_limit: int = 0,
     # stays packed on device — only the chosen indices and the stop flag
     # cross to the host.
     from . import fused
-    fused_runner = fused.make_runner(
-        cfg, pb, consts, verify_against=(consts, carry, min(48, budget)))
+    fused_runner = None
+    if mesh is None:    # the Pallas kernel is single-device; meshes use XLA
+        fused_runner = fused.make_runner(
+            cfg, pb, consts, verify_against=(consts, carry, min(48, budget)))
 
     placements: List[int] = []
     fused_state = None
@@ -700,8 +711,15 @@ def solve(pb: enc.EncodedProblem, max_limit: int = 0,
                            fail_type=FAIL_LIMIT_REACHED,
                            fail_message=f"Maximum number of pods simulated: {max_limit}",
                            node_names=pb.snapshot.node_names)
+    if mesh is not None and jax.process_count() > 1:
+        # gather the node-sharded carry to every host for diagnosis (one
+        # all-gather over DCN at the very end of the solve)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        replicate = jax.jit(lambda c: c, out_shardings=jax.tree.map(
+            lambda _: NamedSharding(mesh, P()), carry))
+        carry = jax.tree.map(np.asarray, replicate(carry))
     if stopped:
-        counts = diagnose(pb, cfg, consts, carry)
+        counts = diagnose(pb, cfg, host_consts, carry)
         msg = format_fit_error(pb.snapshot.num_nodes, counts)
         return SolveResult(placements=placements, placed_count=placed,
                            fail_type=FAIL_UNSCHEDULABLE, fail_message=msg,

@@ -133,7 +133,8 @@ class ClusterCapacity:
                 def node_ok(name, _passing=frozenset(passing)):
                     return name in _passing
             outcome = evaluate(snap, state_pods, self.pod, profile,
-                               node_ok=node_ok)
+                               node_ok=node_ok,
+                               extenders=profile.extenders)
             from .utils.events import (REASON_FAILED_SCHEDULING,
                                        REASON_PREEMPTED, default_recorder)
             default_recorder.eventf(

@@ -38,7 +38,8 @@ _TERMINAL_PHASES = ("Succeeded", "Failed")
 OBJECT_FIELDS = ("services", "pvcs", "pvs", "csinodes", "limit_ranges",
                  "priority_classes", "pdbs", "replication_controllers",
                  "replica_sets", "stateful_sets", "storage_classes",
-                 "namespaces", "resource_slices", "resource_claims",
+                 "namespaces", "csistoragecapacities",
+                 "resource_slices", "resource_claims",
                  "resource_claim_templates", "device_classes")
 
 
@@ -74,6 +75,8 @@ class ClusterSnapshot:
     stateful_sets: List[dict] = field(default_factory=list)
     storage_classes: List[dict] = field(default_factory=list)
     namespaces: List[dict] = field(default_factory=list)
+    # CSIStorageCapacity objects (volumebinding capacity checks)
+    csistoragecapacities: List[dict] = field(default_factory=list)
     # DRA objects (ops/dynamic_resources.py)
     resource_slices: List[dict] = field(default_factory=list)
     resource_claims: List[dict] = field(default_factory=list)

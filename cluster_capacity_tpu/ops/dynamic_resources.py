@@ -1,36 +1,54 @@
-"""DynamicResources (DRA): structured-parameters device allocation, reduced.
+"""DynamicResources (DRA): structured-parameters device allocation.
 
 Reference: /root/reference/vendor/k8s.io/kubernetes/pkg/scheduler/framework/plugins/dynamicresources/
 (2,439 LoC; PreEnqueue→PreBind).  The capacity-relevant core: pods reference
-ResourceClaims (directly or via resourceClaimTemplates); claims request a
-COUNT of devices of a DeviceClass; nodes publish devices through
-ResourceSlices; the plugin filters nodes whose unallocated devices cannot
-satisfy the claim ("cannot allocate all claims").
+ResourceClaims (directly or via resourceClaimTemplates); claims request
+devices of a DeviceClass, optionally narrowed by CEL selectors; nodes
+publish devices through ResourceSlices; the plugin filters nodes whose
+unallocated devices cannot satisfy the claim ("cannot allocate all claims").
 
 TPU-native reduction implemented here:
-- Devices become pseudo-resources `dra/<deviceClassName>` appended to the
-  snapshot's resource axis: per-node allocatable = devices that node's
-  ResourceSlices publish for the class.
-- Template claims (resourceClaimTemplates) are per-pod allocations: each
-  clone charges the claim's device counts (folded into the fit request
-  vector).
+- Plain count requests: devices become pseudo-resources
+  `dra/<deviceClassName>` appended to the snapshot's resource axis;
+  per-node allocatable = devices that node's ResourceSlices publish.
+- CEL selectors / adminAccess / partitionable devices: the structured
+  allocator runs ON THE HOST at encode time — selectors evaluate against
+  each device's attributes/capacity (dynamicresources.go:898 + the
+  structured allocator), shared counters bound partition co-allocation, and
+  the answer folds into one per-node virtual column `dra/__slots__`
+  (allocatable = max clones the node's free devices support, request = 1 per
+  clone) — exact for identical clones because device state never changes
+  mid-solve.
 - SHARED named ResourceClaims are allocated ONCE: their devices are charged
   on the first placement only, every user colocates with the allocation, and
   a claim that is already allocated (status.allocation) pins all users to
   the nodes matching its allocation node selector and charges its devices to
   that node up front.
-
-Out of scope (documented): CEL device selectors, partitionable devices,
-admin access, multi-driver claims — each degrades to count-based matching.
 """
 
 from __future__ import annotations
 
+import re
 from dataclasses import dataclass, field
 from typing import Dict, List, Mapping, Optional, Sequence, Tuple
 
 DRA_RESOURCE_PREFIX = "dra/"
+DRA_SLOTS_RESOURCE = "dra/__slots__"
 REASON_CANNOT_ALLOCATE = "cannot allocate all claims"
+# DeviceAllocationMode All (every matching device goes to the claim)
+COUNT_ALL = -1
+_SLOTS_UNLIMITED = 1e9
+
+
+@dataclass
+class SlotRequest:
+    """One device request that needs the structured allocator (selectors,
+    admin access, or partitionable devices)."""
+
+    device_class: str
+    count: int = 1
+    selectors: List[str] = field(default_factory=list)   # CEL expressions
+    admin_access: bool = False
 
 
 @dataclass
@@ -40,12 +58,337 @@ class DraEncoding:
     # per-class device counts charged once, at the first placement
     # (unallocated shared claims)
     shared_first_requests: Dict[str, int] = field(default_factory=dict)
+    # requests handled by the host-side structured allocator (CEL/admin);
+    # they fold into the per-node dra/__slots__ virtual column
+    slot_requests: List[SlotRequest] = field(default_factory=list)
     # pod references a shared claim → all clones colocate
     shared_claim_colocate: bool = False
     # node selectors from already-allocated claims (every one must match)
     allocation_node_selectors: List[Mapping] = field(default_factory=list)
     # missing claim/class names → pod-level failure
     pod_level_reason: Optional[str] = None
+
+
+# ---------------------------------------------------------------------------
+# CEL device-selector evaluation (host-side subset)
+#
+# DRA selectors are CEL expressions over `device`
+# (resource.k8s.io DeviceSelector.cel.expression), e.g.
+#   device.attributes["driver.example.com"].model == "a100"
+#   device.capacity["driver.example.com"].memory >= 40
+# The practical subset — attribute/capacity lookups, comparisons, &&/||/!,
+# `in`, literals — maps onto Python expression syntax after swapping the
+# boolean operators, and evaluates against a small device view object.
+# ---------------------------------------------------------------------------
+
+class _AttrView:
+    """Attribute access over one qualified-name namespace."""
+
+    def __init__(self, values: Mapping):
+        self._values = dict(values)
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if name not in self._values:
+            raise KeyError(name)
+        return self._values[name]
+
+    def __getitem__(self, name):
+        return self._values[name]
+
+
+class _QualifiedMap:
+    """device.attributes / device.capacity: indexed by driver domain."""
+
+    def __init__(self, by_domain: Mapping[str, Mapping]):
+        self._by_domain = {d: _AttrView(v) for d, v in by_domain.items()}
+
+    def __getitem__(self, domain):
+        if domain not in self._by_domain:
+            return _AttrView({})
+        return self._by_domain[domain]
+
+    def __contains__(self, domain):
+        return domain in self._by_domain
+
+
+class DeviceView:
+    def __init__(self, device: "Device"):
+        self.attributes = _QualifiedMap(device.attributes)
+        self.capacity = _QualifiedMap(device.capacity)
+        self.driver = device.driver
+
+
+_WORD_RE = re.compile(r"[A-Za-z_][A-Za-z_0-9]*")
+
+
+def _cel_to_python(expr: str) -> str:
+    """Token-aware rewrite of the CEL operators/literals Python lacks —
+    string literals and identifier substrings must pass through untouched
+    (e.g. a selector comparing an attribute to the STRING \"true\")."""
+    out = []
+    i = 0
+    n = len(expr)
+    while i < n:
+        ch = expr[i]
+        if ch in "\"'":                       # copy string literals verbatim
+            j = i + 1
+            while j < n and expr[j] != ch:
+                j += 2 if expr[j] == "\\" else 1
+            out.append(expr[i:j + 1])
+            i = j + 1
+        elif expr.startswith("&&", i):
+            out.append(" and ")
+            i += 2
+        elif expr.startswith("||", i):
+            out.append(" or ")
+            i += 2
+        elif ch == "!" and not expr.startswith("!=", i):
+            out.append(" not ")
+            i += 1
+        elif ch.isalpha() or ch == "_":
+            m = _WORD_RE.match(expr, i)
+            word = m.group(0)
+            out.append({"true": "True", "false": "False"}.get(word, word))
+            i = m.end()
+        else:
+            out.append(ch)
+            i += 1
+    return "".join(out)
+
+
+def cel_matches(expr: str, device: "Device") -> bool:
+    """Evaluate one CEL selector against a device.  Failed lookups and
+    evaluation errors mean 'does not match' (the reference treats runtime
+    CEL errors as a non-matching device with an event, allocator.go)."""
+    try:
+        return bool(eval(_cel_to_python(expr),                # noqa: S307
+                         {"__builtins__": {}},
+                         {"device": DeviceView(device)}))
+    except Exception:
+        return False
+
+
+@dataclass
+class Device:
+    """One published device (ResourceSlice.spec.devices[] reduced)."""
+
+    name: str
+    device_class: str
+    driver: str
+    attributes: Dict[str, Dict[str, object]] = field(default_factory=dict)
+    capacity: Dict[str, Dict[str, object]] = field(default_factory=dict)
+    # partitionable devices: counter consumption per shared-counter set
+    consumes: Dict[Tuple[str, str], float] = field(default_factory=dict)
+
+
+def _unwrap_attr(v):
+    """Attribute values are typed unions {string:|int:|bool:|version:}."""
+    if isinstance(v, Mapping):
+        for k in ("string", "int", "bool", "version"):
+            if k in v:
+                return v[k]
+        return None
+    return v
+
+
+def _parse_devices(rs: Mapping) -> List[Device]:
+    from ..utils.quantity import parse_quantity
+    spec = rs.get("spec") or {}
+    driver = spec.get("driver") or ""
+    out = []
+    for dev in spec.get("devices") or []:
+        basic = dev.get("basic") or dev      # 1.31 nests under "basic"
+        attrs: Dict[str, Dict[str, object]] = {}
+        for qname, val in (basic.get("attributes") or {}).items():
+            domain, _, name = qname.rpartition("/")
+            attrs.setdefault(domain or driver, {})[name or qname] = \
+                _unwrap_attr(val)
+        caps: Dict[str, Dict[str, object]] = {}
+        for qname, val in (basic.get("capacity") or {}).items():
+            domain, _, name = qname.rpartition("/")
+            if isinstance(val, Mapping):
+                val = val.get("value", val)
+            try:
+                val = int(parse_quantity(val))
+            except Exception:
+                pass
+            caps.setdefault(domain or driver, {})[name or qname] = val
+        consumes: Dict[Tuple[str, str], float] = {}
+        for cc in basic.get("consumesCounters") or []:
+            cset = cc.get("counterSet") or ""
+            for cname, cval in (cc.get("counters") or {}).items():
+                if isinstance(cval, Mapping):
+                    cval = cval.get("value", 0)
+                try:
+                    consumes[(cset, cname)] = float(parse_quantity(cval))
+                except Exception:
+                    consumes[(cset, cname)] = float(cval or 0)
+        out.append(Device(
+            name=dev.get("name") or "",
+            device_class=dev.get("deviceClassName") or driver,
+            driver=driver, attributes=attrs, capacity=caps,
+            consumes=consumes))
+    return out
+
+
+def _shared_counters(rs: Mapping) -> Dict[Tuple[str, str], float]:
+    from ..utils.quantity import parse_quantity
+    out: Dict[Tuple[str, str], float] = {}
+    for cs in (rs.get("spec") or {}).get("sharedCounters") or []:
+        name = cs.get("name") or ""
+        for cname, cval in (cs.get("counters") or {}).items():
+            if isinstance(cval, Mapping):
+                cval = cval.get("value", 0)
+            try:
+                out[(name, cname)] = float(parse_quantity(cval))
+            except Exception:
+                out[(name, cname)] = float(cval or 0)
+    return out
+
+
+def node_devices(resource_slices: Sequence[Mapping], node_name: str
+                 ) -> Tuple[List[Device], Dict[Tuple[str, str], float]]:
+    """All devices + merged shared-counter pools a node publishes."""
+    devices: List[Device] = []
+    counters: Dict[Tuple[str, str], float] = {}
+    for rs in resource_slices:
+        if (rs.get("spec") or {}).get("nodeName") != node_name:
+            continue
+        devices.extend(_parse_devices(rs))
+        counters.update(_shared_counters(rs))
+    return devices, counters
+
+
+def _class_selectors(device_classes: Sequence[Mapping], name: str
+                     ) -> List[str]:
+    for dc in device_classes:
+        if (dc.get("metadata") or {}).get("name") == name:
+            return [s.get("cel", {}).get("expression", "")
+                    for s in (dc.get("spec") or {}).get("selectors") or []
+                    if s.get("cel")]
+    return []
+
+
+def _request_eligible(dev: Device, req: SlotRequest,
+                      class_selectors: List[str]) -> bool:
+    if req.device_class and dev.device_class != req.device_class:
+        return False
+    for expr in class_selectors + req.selectors:
+        if expr and not cel_matches(expr, dev):
+            return False
+    return True
+
+
+def _fits_k_clones(k: int, units: List[List[int]],
+                   n_devices: int, consumes: List[Dict],
+                   pools: Dict) -> bool:
+    """Can k identical clones be allocated?  units = per-clone unit requests
+    as eligible device-index lists; devices are exclusive and counter pools
+    shared.  Greedy fewest-options-first with counter tracking — the same
+    first-fit shape as the reference's structured allocator."""
+    used = [False] * n_devices
+    remaining = dict(pools)
+    all_units = sorted(units * k, key=len)
+    for elig in all_units:
+        placed = False
+        for di in elig:
+            if used[di]:
+                continue
+            need = consumes[di]
+            if any(remaining.get(key, 0.0) < val
+                   for key, val in need.items()):
+                continue
+            used[di] = True
+            for key, val in need.items():
+                remaining[key] = remaining.get(key, 0.0) - val
+            placed = True
+            break
+        if not placed:
+            return False
+    return True
+
+
+def compute_slot_columns(snapshot, reqs: List[SlotRequest]
+                         ):
+    """Per-node max clone count for the structured requests (the
+    dra/__slots__ virtual column) — host-side, once per encode.
+
+    Devices already held by existing pods' template claims are removed
+    first (greedy, class-eligibility only — their selectors are not
+    re-evaluated, matching the allocator's first-fit)."""
+    import numpy as np
+
+    templates_by_key = claim_index(snapshot.resource_claim_templates)
+    slots = np.zeros(snapshot.num_nodes, dtype=np.float64)
+    admin_ok = np.ones(snapshot.num_nodes, dtype=bool)
+    class_sel = {r.device_class: _class_selectors(snapshot.device_classes,
+                                                  r.device_class)
+                 for r in reqs}
+    # one bucketing pass over the slices, not one scan per node
+    slices_by_node: Dict[str, List[Mapping]] = {}
+    for rs in snapshot.resource_slices:
+        node = (rs.get("spec") or {}).get("nodeName")
+        if node:
+            slices_by_node.setdefault(node, []).append(rs)
+
+    for i, name in enumerate(snapshot.node_names):
+        devices, pools = node_devices(slices_by_node.get(name, ()), name)
+        # remove devices consumed by existing pods (per-class greedy)
+        existing: Dict[str, int] = {}
+        for p in snapshot.pods_by_node[i]:
+            for key, v in template_pod_device_usage(
+                    p, templates_by_key).items():
+                cls = key[len(DRA_RESOURCE_PREFIX):]
+                existing[cls] = existing.get(cls, 0) + v
+        free: List[Device] = []
+        for dev in devices:
+            if existing.get(dev.device_class, 0) > 0:
+                existing[dev.device_class] -= 1
+                for key, val in dev.consumes.items():
+                    pools[key] = pools.get(key, 0.0) - val
+                continue
+            free.append(dev)
+
+        # admin-access requests need an eligible device to exist, consumed
+        # or not (they never allocate exclusively, dynamicresources
+        # AdminAccess semantics); a node failing one is infeasible outright
+        for r in reqs:
+            if r.admin_access and not any(
+                    _request_eligible(d, r, class_sel[r.device_class])
+                    for d in devices):
+                admin_ok[i] = False
+        if not admin_ok[i]:
+            continue                    # slots stay 0 → Insufficient
+
+        consuming = [r for r in reqs if not r.admin_access]
+        if not consuming:
+            slots[i] = _SLOTS_UNLIMITED
+            continue
+        units: List[List[int]] = []
+        all_mode_empty = False
+        for r in consuming:
+            elig = [di for di, d in enumerate(free)
+                    if _request_eligible(d, r, class_sel[r.device_class])]
+            if r.count == COUNT_ALL:
+                # allocationMode All: the clone takes every matching device;
+                # at least one must exist (resource/v1 types.go:847)
+                if not elig:
+                    all_mode_empty = True
+                    break
+                units.extend([elig] * len(elig))
+            else:
+                units.extend([elig] * r.count)
+        if all_mode_empty:
+            continue                    # slots stay 0 → cannot allocate
+        consumes = [d.consumes for d in free]
+        k = 0
+        while k < len(free) and _fits_k_clones(k + 1, units, len(free),
+                                               consumes, pools):
+            k += 1
+        slots[i] = float(k) if units else _SLOTS_UNLIMITED
+    return slots
 
 
 def slice_device_map(resource_slices: Sequence[Mapping]
@@ -102,10 +445,42 @@ def allocation_node_selector(claim: Mapping) -> Optional[Mapping]:
     return alloc.get("nodeSelector")
 
 
+def _claim_slot_requests(claim_spec: Mapping) -> List[SlotRequest]:
+    out = []
+    for req in ((claim_spec.get("devices") or {}).get("requests")) or []:
+        selectors = [s.get("cel", {}).get("expression", "")
+                     for s in req.get("selectors") or [] if s.get("cel")]
+        mode = req.get("allocationMode") or "ExactCount"
+        count = COUNT_ALL if mode == "All" else int(req.get("count", 1) or 1)
+        out.append(SlotRequest(
+            device_class=req.get("deviceClassName") or "",
+            count=count, selectors=[s for s in selectors if s],
+            admin_access=bool(req.get("adminAccess"))))
+    return out
+
+
+def _needs_structured(sreqs: List[SlotRequest],
+                      device_classes: Sequence[Mapping]) -> bool:
+    for r in sreqs:
+        if r.selectors or r.admin_access or r.count == COUNT_ALL:
+            return True
+        if _class_selectors(device_classes, r.device_class):
+            return True
+    return False
+
+
 def encode(pod: Mapping, resource_claims: Sequence[Mapping],
            resource_claim_templates: Sequence[Mapping],
-           namespace_default: str = "default") -> DraEncoding:
-    """Resolve the pod's spec.resourceClaims references."""
+           namespace_default: str = "default",
+           device_classes: Sequence[Mapping] = (),
+           has_shared_counters: bool = False) -> DraEncoding:
+    """Resolve the pod's spec.resourceClaims references.
+
+    Template claims with CEL selectors / adminAccess / All-mode requests —
+    or any claim when the slices publish shared counters (partitionable
+    devices break per-class counting) — route through the structured
+    host-side allocator (slot_requests); plain counted claims stay on the
+    cheap pseudo-resource path."""
     enc = DraEncoding()
     spec = pod.get("spec") or {}
     refs = spec.get("resourceClaims") or []
@@ -115,6 +490,7 @@ def encode(pod: Mapping, resource_claims: Sequence[Mapping],
     claims = claim_index(resource_claims)
     templates = claim_index(resource_claim_templates)
 
+    template_specs: List[Mapping] = []
     for ref in refs:
         claim_name = ref.get("resourceClaimName")
         tmpl_name = ref.get("resourceClaimTemplateName")
@@ -141,7 +517,19 @@ def encode(pod: Mapping, resource_claims: Sequence[Mapping],
                 enc.pod_level_reason = \
                     f'resourceclaimtemplate "{tmpl_name}" not found'
                 return enc
-            claim_spec = ((tmpl.get("spec") or {}).get("spec")) or {}
+            template_specs.append(((tmpl.get("spec") or {}).get("spec")) or {})
+
+    all_sreqs: List[SlotRequest] = []
+    for claim_spec in template_specs:
+        all_sreqs.extend(_claim_slot_requests(claim_spec))
+    if all_sreqs and (has_shared_counters
+                      or _needs_structured(all_sreqs, device_classes)):
+        # one structured request pulls EVERY template request into the
+        # slot allocator — mixing paths would double-account devices a
+        # plain request and a selector request both want
+        enc.slot_requests = all_sreqs
+    else:
+        for claim_spec in template_specs:
             for k, v in _claim_requests(claim_spec).items():
                 enc.per_clone_requests[k] = \
                     enc.per_clone_requests.get(k, 0) + v

@@ -29,13 +29,34 @@ def static_mask(snapshot: ClusterSnapshot, pod: dict) -> np.ndarray:
          for i in range(snapshot.num_nodes)], dtype=bool)
 
 
-def has_preferred_terms(pod: dict) -> bool:
+def has_preferred_terms(pod: dict, added_affinity: dict = None) -> bool:
+    """PreScore skips when neither the pod nor NodeAffinityArgs.addedAffinity
+    carries preferred terms (node_affinity.go:246-249 + :98-106)."""
     affinity = ((pod.get("spec") or {}).get("affinity") or {}).get("nodeAffinity") or {}
-    return bool(affinity.get("preferredDuringSchedulingIgnoredDuringExecution"))
+    if affinity.get("preferredDuringSchedulingIgnoredDuringExecution"):
+        return True
+    return bool((added_affinity or {}).get(
+        "preferredDuringSchedulingIgnoredDuringExecution"))
 
 
-def static_raw_score(snapshot: ClusterSnapshot, pod: dict) -> np.ndarray:
+def static_raw_score(snapshot: ClusterSnapshot, pod: dict,
+                     added_affinity: dict = None) -> np.ndarray:
+    """Raw preferred-term score per node; NodeAffinityArgs.addedAffinity
+    preferred terms score every pod of the profile on top of the pod's own
+    (node_affinity.go:98-106 + :260-285)."""
     spec = pod.get("spec") or {}
+    added = (added_affinity or {}).get(
+        "preferredDuringSchedulingIgnoredDuringExecution")
+    if added:
+        spec = dict(spec)
+        own = ((spec.get("affinity") or {}).get("nodeAffinity") or {}).get(
+            "preferredDuringSchedulingIgnoredDuringExecution") or []
+        affinity = dict(spec.get("affinity") or {})
+        node_aff = dict(affinity.get("nodeAffinity") or {})
+        node_aff["preferredDuringSchedulingIgnoredDuringExecution"] = \
+            list(own) + list(added)
+        affinity["nodeAffinity"] = node_aff
+        spec["affinity"] = affinity
     return np.asarray(
         [preferred_node_affinity_score(spec, snapshot.node_labels(i),
                                        snapshot.node_names[i])

@@ -28,7 +28,7 @@ from typing import Dict, List, Mapping, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
-from ..models.labels import match_node_selector
+from ..models.labels import match_label_selector, match_node_selector
 from ..models.snapshot import ClusterSnapshot
 
 REASON_UNBOUND_IMMEDIATE = "pod has unbound immediate PersistentVolumeClaims"
@@ -40,6 +40,7 @@ REASON_RWOP_CONFLICT = ("node(s) unavailable due to PersistentVolumeClaim with "
                         "ReadWriteOncePod access mode already in-use by "
                         "another pod")
 REASON_MAX_VOLUME_COUNT = "node(s) exceed max volume count"
+REASON_NOT_ENOUGH_SPACE = "node(s) did not have enough free storage"
 
 _ZONE_LABELS = ("topology.kubernetes.io/zone", "topology.kubernetes.io/region",
                 "failure-domain.beta.kubernetes.io/zone",
@@ -330,6 +331,61 @@ def _pv_node_ok(pv: dict, snapshot: ClusterSnapshot, i: int) -> bool:
                                snapshot.node_names[i])
 
 
+def _topology_terms_match(terms: List[dict], labels: Mapping[str, str]) -> bool:
+    """v1helper.MatchTopologySelectorTerms: ANY term matches, every
+    matchLabelExpression of the term must match (key present, value in set)."""
+    if not terms:
+        return True
+    for term in terms:
+        exprs = term.get("matchLabelExpressions") or []
+        ok = True
+        for e in exprs:
+            val = labels.get(e.get("key", ""))
+            if val is None or val not in (e.get("values") or []):
+                ok = False
+                break
+        if ok:
+            return True
+    return False
+
+
+def _claim_size(pvc: dict) -> int:
+    from ..utils.quantity import parse_quantity
+    want = (((pvc.get("spec") or {}).get("resources") or {})
+            .get("requests") or {}).get("storage")
+    return int(parse_quantity(want)) if want is not None else 0
+
+
+def _has_enough_capacity(snapshot: ClusterSnapshot, pvc: dict, sc: dict,
+                         i: int) -> bool:
+    """binder.go hasEnoughCapacity: when the driver publishes
+    CSIStorageCapacity objects for the storage class, some object whose
+    nodeTopology matches the node must cover the claim size (and its
+    maximumVolumeSize, when set, must too); a driver publishing nothing is
+    assumed unlimited."""
+    from ..utils.quantity import parse_quantity
+
+    sc_name = (sc.get("metadata") or {}).get("name", "")
+    relevant = [c for c in snapshot.csistoragecapacities
+                if c.get("storageClassName") == sc_name]
+    if not relevant:
+        return True
+    size = _claim_size(pvc)
+    labels = snapshot.node_labels(i)
+    for cap in relevant:
+        topo = cap.get("nodeTopology")
+        if topo is not None and not match_label_selector(topo, labels):
+            continue
+        capacity = cap.get("capacity")
+        if capacity is None or parse_quantity(capacity) < size:
+            continue
+        max_size = cap.get("maximumVolumeSize")
+        if max_size is not None and parse_quantity(max_size) < size:
+            continue
+        return True
+    return False
+
+
 def _volume_binding(snapshot: ClusterSnapshot, claims: List[dict],
                     pvs: Dict[str, dict], scs: Dict[str, dict],
                     verdict: VolumeVerdict) -> None:
@@ -357,12 +413,22 @@ def _volume_binding(snapshot: ClusterSnapshot, claims: List[dict],
         if not verdict.mask[i]:
             continue
         for pvc, sc in wait_unbound:
-            # static provisioning: some unbound (or pre-bound-to-this-claim)
-            # PV must match claim + node; dynamic provisioning (a real
-            # provisioner) is assumed to succeed.
             provisioner = sc.get("provisioner") or ""
             if provisioner and provisioner != "kubernetes.io/no-provisioner":
+                # dynamic provisioning (binder.go checkVolumeProvisions):
+                # the class's allowedTopologies must admit the node, and the
+                # driver's published CSIStorageCapacity must cover the claim.
+                if not _topology_terms_match(
+                        sc.get("allowedTopologies") or [],
+                        snapshot.node_labels(i)):
+                    _fail(verdict, i, REASON_BINDING)
+                    break
+                if not _has_enough_capacity(snapshot, pvc, sc, i):
+                    _fail(verdict, i, REASON_NOT_ENOUGH_SPACE)
+                    break
                 continue
+            # static provisioning: some unbound (or pre-bound-to-this-claim)
+            # PV must match claim + node.
             candidates = [pv for pv in pvs.values()
                           if _pv_matches_claim(pv, pvc)]
             if not any(_pv_node_ok(pv, snapshot, i) for pv in candidates):

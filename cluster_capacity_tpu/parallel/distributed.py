@@ -1,0 +1,151 @@
+"""Multi-host (DCN) backend: jax.distributed init + host-sharded snapshots.
+
+The reference is a single-process program whose only transports are in-memory
+watch channels and one HTTPS sync (SURVEY.md §2d item 4).  The TPU-native
+scale-out story is: every host runs one process, `jax.distributed` wires the
+processes into one runtime, the node axis shards over ALL hosts' devices
+(ICI within a host, DCN across hosts), and XLA inserts the cross-host
+collectives for the solve's global reductions (feasible-any, normalize
+max/min, argmax host selection, spread min-over-countable).
+
+Pieces:
+- initialize(): jax.distributed.initialize wrapper (coordinator, pid, count).
+- global_mesh(): a (batch, nodes) Mesh over every process's devices.
+- split_objects()/shard_path(): deterministic contiguous node shards so each
+  host parses only its slice of a big snapshot (the host-side JSON/string
+  work is the multi-host loading bottleneck at 100k+ nodes).
+- allgather_objects(): exchange the parsed shards once over DCN (pickled
+  object lists via process_allgather), giving every host the full object
+  set for constraint-vocabulary encoding.
+- solve_on_mesh(): the standard engine with consts/carry sharded over the
+  global mesh — identical placements to a single-process solve
+  (tests/test_distributed.py proves it with 2 CPU processes).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import mesh as mesh_lib
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Join the multi-host runtime.  Arguments fall back to the standard env
+    vars (CC_COORDINATOR / CC_NUM_PROCESSES / CC_PROCESS_ID), so launchers
+    can configure processes uniformly."""
+    import jax
+
+    coordinator_address = coordinator_address or os.environ.get(
+        "CC_COORDINATOR")
+    if num_processes is None:
+        num_processes = int(os.environ.get("CC_NUM_PROCESSES", "1"))
+    if process_id is None:
+        process_id = int(os.environ.get("CC_PROCESS_ID", "0"))
+    if num_processes > 1:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id)
+
+
+def global_mesh(n_batch_shards: int = 1):
+    """A (batch, nodes) mesh over every process's devices (jax.devices() is
+    global after initialize())."""
+    import jax
+    return mesh_lib.make_mesh(
+        n_node_shards=len(jax.devices()) // n_batch_shards,
+        n_batch_shards=n_batch_shards)
+
+
+def split_objects(nodes: Sequence[dict], num_shards: int
+                  ) -> List[List[dict]]:
+    """Deterministic contiguous node shards (balanced sizes)."""
+    n = len(nodes)
+    bounds = [(n * k) // num_shards for k in range(num_shards + 1)]
+    return [list(nodes[bounds[k]:bounds[k + 1]]) for k in range(num_shards)]
+
+
+def write_sharded_snapshot(path: str, nodes: Sequence[dict],
+                           num_shards: int, **rest) -> List[str]:
+    """Split a snapshot into per-host files `<path>.<k>.json`: the node list
+    shards; every other object kind rides with shard 0."""
+    paths = []
+    for k, shard in enumerate(split_objects(nodes, num_shards)):
+        payload = {"nodes": shard}
+        if k == 0:
+            payload.update(rest)
+        p = f"{path}.{k}.json"
+        with open(p, "w") as f:
+            json.dump(payload, f)
+        paths.append(p)
+    return paths
+
+
+def load_shard(path: str, process_id: int) -> dict:
+    with open(f"{path}.{process_id}.json") as f:
+        return json.load(f)
+
+
+def allgather_objects(local: object) -> List[object]:
+    """Exchange arbitrary picklable per-host payloads: every host returns
+    [payload_0, ..., payload_{P-1}].  Uses process_allgather over a padded
+    uint8 view of the pickle (DCN transfer happens once, at load time)."""
+    import jax
+    from jax.experimental import multihost_utils
+
+    if jax.process_count() == 1:
+        return [local]
+    blob = np.frombuffer(pickle.dumps(local), dtype=np.uint8)
+    size = np.asarray([blob.shape[0]], dtype=np.int64)
+    sizes = multihost_utils.process_allgather(size)          # [P, 1]
+    max_len = int(sizes.max())
+    padded = np.zeros(max_len, dtype=np.uint8)
+    padded[: blob.shape[0]] = blob
+    blobs = multihost_utils.process_allgather(padded)        # [P, max_len]
+    return [pickle.loads(blobs[p, : int(sizes[p, 0])].tobytes())
+            for p in range(blobs.shape[0])]
+
+
+def load_snapshot_distributed(path: str):
+    """Host-sharded snapshot load: this process parses only its own shard
+    file, the parsed objects are exchanged once, and every host builds the
+    same ClusterSnapshot (object order is shard-order, so vocabularies and
+    node indices agree everywhere)."""
+    import jax
+
+    from ..models.snapshot import ClusterSnapshot
+
+    if jax.process_count() > 1:
+        shards = allgather_objects(load_shard(path, jax.process_index()))
+    else:
+        shards = []
+        while os.path.exists(f"{path}.{len(shards)}.json"):
+            shards.append(load_shard(path, len(shards)))
+        if not shards:
+            raise FileNotFoundError(
+                f"no snapshot shards found at {path}.<k>.json")
+    nodes: List[dict] = []
+    rest: dict = {}
+    for shard in shards:
+        nodes.extend(shard.get("nodes") or [])
+        for k, v in shard.items():
+            if k != "nodes" and v:
+                rest.setdefault(k, []).extend(v)
+    return ClusterSnapshot.from_objects(nodes, **rest)
+
+
+def solve_on_mesh(pb, mesh, max_limit: int = 0, chunk_size: int = 1024):
+    """The scan engine with consts + carry sharded over a (multi-host) mesh —
+    a thin alias for engine.simulator.solve(mesh=...), which keeps every
+    guard branch (pod-level gates, empty clusters, budget exhaustion) in one
+    place.  Returns the same SolveResult on every host."""
+    from ..engine import simulator as sim
+
+    return sim.solve(pb, max_limit=max_limit, chunk_size=chunk_size,
+                     mesh=mesh)

@@ -49,6 +49,22 @@ DEFAULT_PRE_ENQUEUE = ["SchedulingGates"]
 
 ALL_SCORE_PLUGINS = list(DEFAULT_SCORE_WEIGHTS)
 
+# Every plugin name this framework implements, per extension point — the
+# vocabulary ValidateKubeSchedulerConfiguration checks against
+# (cmd/cluster-capacity/app/server.go:111; apis/config/validation).
+KNOWN_PLUGINS = set(DEFAULT_FILTERS) | set(DEFAULT_SCORE_WEIGHTS) | {
+    "SchedulingGates", "PrioritySort", "DefaultPreemption", "DefaultBinder",
+    "VolumeRestrictions", "NodeVolumeLimits", "VolumeBinding", "VolumeZone",
+}
+_SCORING_STRATEGIES = {"LeastAllocated", "MostAllocated",
+                       "RequestedToCapacityRatio"}
+
+
+class ConfigValidationError(ValueError):
+    """A malformed or unknown KubeSchedulerConfiguration field — the analog
+    of ValidateKubeSchedulerConfiguration rejecting the config at startup
+    instead of silently running with defaults."""
+
 
 @dataclass
 class ScoringStrategy:
@@ -125,11 +141,13 @@ def load_scheduler_config(path: str) -> SchedulerProfile:
 
     Supports: profiles[0].plugins.{filter,score}.{enabled,disabled} (with "*"
     wildcard) and pluginConfig args for NodeResourcesFitArgs scoringStrategy.
-    Unknown plugins are preserved by name but have no kernel; enabling one that
-    has no implementation raises.
+    Malformed configs are rejected loudly (ConfigValidationError), mirroring
+    ValidateKubeSchedulerConfiguration at cmd/cluster-capacity/app/server.go:111
+    — a typo'd plugin name must not silently run with defaults.
     """
     with open(path) as f:
         cfg = yaml.safe_load(f) or {}
+    _validate_config(cfg)
     prof = SchedulerProfile()
     profiles = cfg.get("profiles") or []
     if not profiles:
@@ -206,3 +224,90 @@ def load_scheduler_config(path: str) -> SchedulerProfile:
         from ..engine.extenders import parse_extenders
         prof.extenders = parse_extenders(cfg)
     return prof
+
+
+def _validate_config(cfg: dict) -> None:
+    """Reject unknown plugin names and malformed fields before anything runs
+    (the ValidateKubeSchedulerConfiguration analog).  Malformed TYPES must
+    also surface as ConfigValidationError, not raw tracebacks."""
+    try:
+        _validate_config_inner(cfg)
+    except ConfigValidationError:
+        raise
+    except Exception as e:
+        raise ConfigValidationError(
+            f"invalid KubeSchedulerConfiguration: malformed structure "
+            f"({type(e).__name__}: {e})") from e
+
+
+def _validate_config_inner(cfg: dict) -> None:
+    errs: List[str] = []
+
+    kind = cfg.get("kind")
+    if kind is not None and kind != "KubeSchedulerConfiguration":
+        errs.append(f"unexpected kind {kind!r} "
+                    f"(want KubeSchedulerConfiguration)")
+    api = cfg.get("apiVersion")
+    if api is not None and not str(api).startswith(
+            "kubescheduler.config.k8s.io/"):
+        errs.append(f"unexpected apiVersion {api!r}")
+
+    profiles = cfg.get("profiles") or []
+    if len(profiles) > 1:
+        # the reference forces a single profile renamed default-scheduler
+        # (pkg/utils/utils.go:102-108)
+        errs.append(f"exactly one profile is supported, got {len(profiles)}")
+    for p in profiles:
+        if not isinstance(p, dict):
+            errs.append(f"profile entries must be mappings, got {type(p).__name__}")
+            continue
+        plugins = p.get("plugins") or {}
+        if not isinstance(plugins, dict):
+            errs.append("profiles[].plugins must be a mapping")
+            plugins = {}
+        for section, sec in plugins.items():
+            for kind_key in ("enabled", "disabled"):
+                for e in (sec or {}).get(kind_key) or []:
+                    name = (e or {}).get("name")
+                    if name is None:
+                        errs.append(f"plugins.{section}.{kind_key} entry "
+                                    f"without a name")
+                    elif name != "*" and name not in KNOWN_PLUGINS:
+                        errs.append(f"unknown plugin "
+                                    f"plugins.{section}.{kind_key}: {name!r}")
+                    w = (e or {}).get("weight")
+                    if w is not None:
+                        try:
+                            if int(w) < 0:
+                                errs.append(f"plugin {name!r}: weight must "
+                                            f"be >= 0")
+                        except (TypeError, ValueError):
+                            errs.append(f"plugin {name!r}: weight {w!r} is "
+                                        f"not an integer")
+        for pc in p.get("pluginConfig") or []:
+            name = (pc or {}).get("name")
+            if name not in KNOWN_PLUGINS:
+                errs.append(f"pluginConfig for unknown plugin {name!r}")
+            if name == "NodeResourcesFit":
+                strat = ((pc.get("args") or {}).get("scoringStrategy")
+                         or {}).get("type")
+                if strat and strat not in _SCORING_STRATEGIES:
+                    errs.append(f"unknown scoringStrategy type {strat!r}")
+        pct = p.get("percentageOfNodesToScore")
+        if pct is not None and not (0 <= int(pct) <= 100):
+            errs.append(f"percentageOfNodesToScore must be in [0, 100], "
+                        f"got {pct}")
+    pct = cfg.get("percentageOfNodesToScore")
+    if pct is not None and not (0 <= int(pct) <= 100):
+        errs.append(f"percentageOfNodesToScore must be in [0, 100], got {pct}")
+    for e in cfg.get("extenders") or []:
+        if not (e or {}).get("urlPrefix"):
+            errs.append("extender without urlPrefix")
+        for verb in ("filterVerb", "prioritizeVerb", "bindVerb",
+                     "preemptVerb"):
+            v = (e or {}).get(verb)
+            if v is not None and not isinstance(v, str):
+                errs.append(f"extender {verb} must be a string")
+    if errs:
+        raise ConfigValidationError(
+            "invalid KubeSchedulerConfiguration: " + "; ".join(errs))

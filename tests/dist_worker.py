@@ -1,0 +1,41 @@
+"""Worker process for tests/test_distributed.py: joins the 2-process CPU
+runtime, loads its snapshot shard, solves on the global mesh, and (process 0)
+writes the placements for the parent to compare."""
+
+import json
+import os
+import sys
+
+
+def main():
+    snapshot_path, out_path, max_limit = sys.argv[1], sys.argv[2], int(sys.argv[3])
+
+    import jax
+    jax.config.update("jax_enable_x64", True)
+
+    from cluster_capacity_tpu.parallel import distributed as dist
+    from cluster_capacity_tpu.engine import encode as enc
+    from cluster_capacity_tpu.models.podspec import default_pod
+    from cluster_capacity_tpu.utils.config import SchedulerProfile
+
+    dist.initialize()
+    mesh = dist.global_mesh()
+    snapshot = dist.load_snapshot_distributed(snapshot_path)
+
+    with open(snapshot_path + ".pod.json") as f:
+        pod = json.load(f)
+    pb = enc.encode_problem(snapshot, default_pod(pod),
+                            SchedulerProfile.parity())
+    res = dist.solve_on_mesh(pb, mesh, max_limit=max_limit)
+
+    if jax.process_index() == 0:
+        with open(out_path, "w") as f:
+            json.dump({"placements": res.placements,
+                       "fail_type": res.fail_type,
+                       "fail_message": res.fail_message,
+                       "processes": jax.process_count(),
+                       "devices": len(jax.devices())}, f)
+
+
+if __name__ == "__main__":
+    main()

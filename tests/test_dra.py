@@ -158,3 +158,162 @@ def test_unpublished_device_class_unschedulable():
     res = cc.run()
     assert res.placed_count == 0
     assert "cannot allocate all claims" in res.fail_message
+
+
+# --- structured allocation: CEL selectors / admin access / partitions ------
+
+def _attr_slice(node, devices, driver="gpu.example.com", counters=None):
+    """devices: list of dicts {name, attributes, capacity, consumesCounters}."""
+    spec = {"nodeName": node, "driver": driver,
+            "devices": [dict(d, deviceClassName=d.get("deviceClassName",
+                                                      driver))
+                        for d in devices]}
+    if counters:
+        spec["sharedCounters"] = counters
+    return {"metadata": {"name": f"slice-{node}"}, "spec": spec}
+
+
+def _sel_template(name, expr=None, count=1, admin=False, mode=None,
+                  cls="gpu.example.com"):
+    req = {"name": "r0", "deviceClassName": cls, "count": count}
+    if expr:
+        req["selectors"] = [{"cel": {"expression": expr}}]
+    if admin:
+        req["adminAccess"] = True
+    if mode:
+        req["allocationMode"] = mode
+    return {"metadata": {"name": name, "namespace": "default"},
+            "spec": {"spec": {"devices": {"requests": [req]}}}}
+
+
+def _run_dra(pod, nodes, **extra):
+    cc = ClusterCapacity(default_pod(pod), profile=SchedulerProfile.parity())
+    cc.sync_with_objects(nodes, **extra)
+    return cc.run()
+
+
+def test_cel_selector_narrows_devices():
+    """device.attributes CEL selector: only a100 devices satisfy the claim
+    (dynamicresources.go:898 + structured allocator)."""
+    nodes = [build_test_node("n1", 100000, int(1e11), 500)]
+    devices = [
+        {"name": "d0", "attributes": {"gpu.example.com/model": {"string": "a100"}}},
+        {"name": "d1", "attributes": {"gpu.example.com/model": {"string": "a100"}}},
+        {"name": "d2", "attributes": {"gpu.example.com/model": {"string": "t4"}}},
+    ]
+    tmpl = _sel_template(
+        "a100", expr='device.attributes["gpu.example.com"].model == "a100"')
+    res = _run_dra(_pod_with_template_claim("p", "a100"), nodes,
+                   resource_slices=[_attr_slice("n1", devices)],
+                   resource_claim_templates=[tmpl])
+    assert res.placed_count == 2          # only the two a100s
+    assert res.fail_counts.get("cannot allocate all claims") == 1
+
+
+def test_cel_capacity_comparison():
+    nodes = [build_test_node("n1", 100000, int(1e11), 500)]
+    devices = [
+        {"name": "d0", "capacity": {"gpu.example.com/memory": "40Gi"}},
+        {"name": "d1", "capacity": {"gpu.example.com/memory": "16Gi"}},
+    ]
+    tmpl = _sel_template(
+        "big", expr='device.capacity["gpu.example.com"].memory >= 34359738368')
+    res = _run_dra(_pod_with_template_claim("p", "big"), nodes,
+                   resource_slices=[_attr_slice("n1", devices)],
+                   resource_claim_templates=[tmpl])
+    assert res.placed_count == 1
+
+
+def test_admin_access_does_not_consume():
+    """adminAccess requests require the device to exist but never consume
+    it — unlimited monitoring pods."""
+    nodes = [build_test_node("n1", 100000, int(1e11), 500),
+             build_test_node("n2", 100000, int(1e11), 500)]
+    devices = [{"name": "d0"}]
+    tmpl = _sel_template("mon", admin=True)
+    cc = ClusterCapacity(default_pod(_pod_with_template_claim("p", "mon")),
+                         max_limit=7, profile=SchedulerProfile.parity())
+    cc.sync_with_objects(nodes, resource_slices=[_attr_slice("n1", devices)],
+                         resource_claim_templates=[tmpl])
+    res = cc.run()
+    assert res.placed_count == 7
+    assert set(res.per_node_counts) == {"n1"}   # n2 publishes no device
+
+
+def test_partitionable_devices_share_counters():
+    """Partitions consume sharedCounters: two half-partitions exhaust the
+    pool even though four partition devices are published."""
+    nodes = [build_test_node("n1", 100000, int(1e11), 500)]
+    devices = [
+        {"name": f"p{i}",
+         "consumesCounters": [{"counterSet": "gpu0",
+                               "counters": {"memory": {"value": "20Gi"}}}]}
+        for i in range(4)
+    ]
+    counters = [{"name": "gpu0", "counters": {"memory": {"value": "40Gi"}}}]
+    tmpl = _sel_template("part", count=1)
+    res = _run_dra(_pod_with_template_claim("p", "part"), nodes,
+                   resource_slices=[_attr_slice("n1", devices,
+                                                counters=counters)],
+                   resource_claim_templates=[tmpl])
+    assert res.placed_count == 2          # 40Gi pool / 20Gi per partition
+    assert res.fail_counts.get("cannot allocate all claims") == 1
+
+
+def test_allocation_mode_all():
+    """All-mode claims take every matching device: exactly one clone."""
+    nodes = [build_test_node("n1", 100000, int(1e11), 500)]
+    devices = [{"name": f"d{i}"} for i in range(3)]
+    tmpl = _sel_template("all", mode="All")
+    res = _run_dra(_pod_with_template_claim("p", "all"), nodes,
+                   resource_slices=[_attr_slice("n1", devices)],
+                   resource_claim_templates=[tmpl])
+    assert res.placed_count == 1
+
+
+def test_device_class_selectors_apply():
+    """DeviceClass.spec.selectors narrow devices for every claim of the
+    class (the class's CEL runs before the claim's)."""
+    nodes = [build_test_node("n1", 100000, int(1e11), 500)]
+    devices = [
+        {"name": "d0", "attributes": {"gpu.example.com/tier": {"string": "prod"}}},
+        {"name": "d1", "attributes": {"gpu.example.com/tier": {"string": "dev"}}},
+    ]
+    dc = {"metadata": {"name": "gpu.example.com"},
+          "spec": {"selectors": [{"cel": {"expression":
+              'device.attributes["gpu.example.com"].tier == "prod"'}}]}}
+    tmpl = _sel_template("any", count=1)
+    res = _run_dra(_pod_with_template_claim("p", "any"), nodes,
+                   resource_slices=[_attr_slice("n1", devices)],
+                   resource_claim_templates=[tmpl], device_classes=[dc])
+    assert res.placed_count == 1          # only the prod device
+
+
+def test_cel_string_literal_true_not_mangled():
+    """Regression: a selector comparing to the STRING "true" must not be
+    rewritten to the boolean literal."""
+    nodes = [build_test_node("n1", 100000, int(1e11), 500)]
+    devices = [{"name": "d0",
+                "attributes": {"gpu.example.com/sriov": {"string": "true"}}}]
+    tmpl = _sel_template(
+        "sriov", expr='device.attributes["gpu.example.com"].sriov == "true"')
+    res = _run_dra(_pod_with_template_claim("p", "sriov"), nodes,
+                   resource_slices=[_attr_slice("n1", devices)],
+                   resource_claim_templates=[tmpl])
+    assert res.placed_count == 1
+
+
+def test_allocation_mode_all_requires_a_device():
+    """Regression: All-mode with zero matching devices must be infeasible
+    (resource/v1 types.go: at least one device must exist)."""
+    nodes = [build_test_node("n1", 100000, int(1e11), 500)]
+    devices = [{"name": "d0",
+                "attributes": {"gpu.example.com/model": {"string": "t4"}}}]
+    tmpl = _sel_template(
+        "all-a100", mode="All",
+        expr='device.attributes["gpu.example.com"].model == "a100"')
+    res = _run_dra(_pod_with_template_claim("p", "all-a100"), nodes,
+                   resource_slices=[_attr_slice("n1", devices)],
+                   resource_claim_templates=[tmpl])
+    assert res.placed_count == 0
+    assert "cannot allocate all claims" in res.fail_message

@@ -159,3 +159,72 @@ def test_scheduling_gates():
     res = _run(pod, nodes)
     assert res.placed_count == 0
     assert res.fail_type == "SchedulingGated"
+
+
+def _wffc_sc(name="fast", provisioner="ebs.csi.example.com",
+             allowed_topologies=None):
+    sc = {"metadata": {"name": name},
+          "provisioner": provisioner,
+          "volumeBindingMode": "WaitForFirstConsumer"}
+    if allowed_topologies:
+        sc["allowedTopologies"] = allowed_topologies
+    return sc
+
+
+def _zone_nodes():
+    return [build_test_node(
+        f"n{i}", 2000, 4 * 1024 ** 3, 10,
+        labels={"kubernetes.io/hostname": f"n{i}",
+                "topology.kubernetes.io/zone": f"z{i % 2}"})
+        for i in range(4)]
+
+
+def test_wffc_allowed_topologies_restricts_nodes():
+    """binder.go checkVolumeProvisions: StorageClass.allowedTopologies must
+    admit the node for dynamic provisioning."""
+    pod = build_test_pod("p", 100, 0)
+    pod["spec"]["volumes"] = [{"name": "data",
+                               "persistentVolumeClaim": {"claimName": "c"}}]
+    sc = _wffc_sc(allowed_topologies=[{"matchLabelExpressions": [{
+        "key": "topology.kubernetes.io/zone", "values": ["z1"]}]}])
+    res = _run(pod, _zone_nodes(), storage_classes=[sc],
+               pvcs=[_pvc("c", sc="fast")])
+    # only z1 nodes (n1, n3) are provisionable
+    assert set(res.per_node_counts) == {"n1", "n3"}
+    assert "didn't find available persistent volumes to bind" in res.fail_message
+
+
+def test_wffc_csi_storage_capacity():
+    """binder.go hasEnoughCapacity: published CSIStorageCapacity objects gate
+    dynamic provisioning per node topology; nothing published = unlimited."""
+    pod = build_test_pod("p", 100, 0)
+    pod["spec"]["volumes"] = [{"name": "data",
+                               "persistentVolumeClaim": {"claimName": "c"}}]
+    sc = _wffc_sc()
+    caps = [
+        {"storageClassName": "fast", "capacity": "100Gi",
+         "nodeTopology": {"matchLabels": {
+             "topology.kubernetes.io/zone": "z0"}}},
+        {"storageClassName": "fast", "capacity": "512Mi",   # too small
+         "nodeTopology": {"matchLabels": {
+             "topology.kubernetes.io/zone": "z1"}}},
+    ]
+    res = _run(pod, _zone_nodes(), storage_classes=[sc],
+               pvcs=[_pvc("c", sc="fast", storage="1Gi")],
+               csistoragecapacities=caps)
+    # only z0 (n0, n2) has >= 1Gi published capacity
+    assert set(res.per_node_counts) == {"n0", "n2"}
+    assert "did not have enough free storage" in res.fail_message
+
+    # maximumVolumeSize caps individual volumes even with large capacity
+    caps2 = [{"storageClassName": "fast", "capacity": "100Gi",
+              "maximumVolumeSize": "512Mi"}]
+    res2 = _run(pod, _zone_nodes(), storage_classes=[sc],
+                pvcs=[_pvc("c", sc="fast", storage="1Gi")],
+                csistoragecapacities=caps2)
+    assert res2.placed_count == 0
+
+    # no capacity objects for the class -> assumed unlimited
+    res3 = _run(pod, _zone_nodes(), storage_classes=[sc],
+                pvcs=[_pvc("c", sc="fast", storage="1Gi")])
+    assert res3.placed_count > 0 and len(res3.per_node_counts) == 4
