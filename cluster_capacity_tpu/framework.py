@@ -46,12 +46,52 @@ class ClusterCapacity:
             nodes, pods, exclude_nodes=self.exclude_nodes,
             **self._snapshot_options, **extra)
 
-    def sync_with_client(self, client) -> None:
-        """SyncWithClient over a live kubernetes.client-compatible API object
-        (duck-typed; anything exposing list_node/list_pod_for_all_namespaces)."""
+    # live-sync resource kinds beyond nodes/pods: duck-typed method name →
+    # sync_with_objects keyword (the reference copies the same ten kinds,
+    # simulator.go:176-295; storage/policy/scheduling APIs may live on the
+    # same facade object or be absent entirely)
+    _SYNC_METHODS = (
+        ("list_namespace", "namespaces"),
+        ("list_service_for_all_namespaces", "services"),
+        ("list_persistent_volume_claim_for_all_namespaces", "pvcs"),
+        ("list_persistent_volume", "pvs"),
+        ("list_replication_controller_for_all_namespaces",
+         "replication_controllers"),
+        ("list_pod_disruption_budget_for_all_namespaces", "pdbs"),
+        ("list_replica_set_for_all_namespaces", "replica_sets"),
+        ("list_stateful_set_for_all_namespaces", "stateful_sets"),
+        ("list_storage_class", "storage_classes"),
+        ("list_csi_node", "csinodes"),
+        ("list_csi_storage_capacity_for_all_namespaces",
+         "csistoragecapacities"),
+        ("list_priority_class", "priority_classes"),
+        ("list_limit_range_for_all_namespaces", "limit_ranges"),
+        ("list_resource_slice", "resource_slices"),
+        ("list_resource_claim_for_all_namespaces", "resource_claims"),
+        ("list_resource_claim_template_for_all_namespaces",
+         "resource_claim_templates"),
+        ("list_device_class", "device_classes"),
+    )
+
+    def sync_with_client(self, client, *extra_apis) -> None:
+        """SyncWithClient over live kubernetes.client-compatible API objects
+        (duck-typed).  `client` must expose list_node/
+        list_pod_for_all_namespaces; every other resource kind the reference
+        syncs (simulator.go:176-295) is fetched from whichever of
+        (client, *extra_apis) exposes its list method — pass the AppsV1 /
+        PolicyV1 / StorageV1 / SchedulingV1 API objects for full parity."""
+        apis = (client,) + tuple(extra_apis)
         nodes = [_to_dict(x) for x in client.list_node().items]
         pods = [_to_dict(x) for x in client.list_pod_for_all_namespaces().items]
-        self.sync_with_objects(nodes, pods)
+        extra = {}
+        for method, kw in self._SYNC_METHODS:
+            for api in apis:
+                fn = getattr(api, method, None)
+                if fn is None:
+                    continue
+                extra[kw] = [_to_dict(x) for x in fn().items]
+                break
+        self.sync_with_objects(nodes, pods, **extra)
 
     def run(self) -> SolveResult:
         if self.snapshot is None:
@@ -84,22 +124,13 @@ class ClusterCapacity:
         profile = self.profile
         preempt_on = "DefaultPreemption" in profile.post_filters
 
-        working_pods: List[dict] = [p for plist in snapshot.pods_by_node
-                                    for p in plist]
+        snap = snapshot
         placements: List[int] = []
         clone_seq = 0
         result: Optional[SolveResult] = None
 
         while True:
             with tracer.span(SPAN_SNAPSHOT):
-                snap = snapshot if not placements and \
-                    len(working_pods) == sum(len(p) for p in
-                                             snapshot.pods_by_node) \
-                    else ClusterSnapshot.from_objects(
-                        snapshot.nodes, working_pods,
-                        **getattr(self, "_snapshot_options", {}),
-                        **{k: getattr(snapshot, k)
-                           for k in snapshot_mod.OBJECT_FIELDS})
                 problem = encode_problem(snap, self.pod, profile)
             remaining = (self.max_limit - len(placements)) \
                 if self.max_limit else 0
@@ -151,15 +182,40 @@ class ClusterCapacity:
                     result.fail_message += " " + format_preemption_message(
                         snap.num_nodes, outcome.message_counts)
                 break
-            # evict victims and resume; clones placed so far become pods
+            # evict victims and resume; clones placed so far become pods.
+            # Victims match by object identity OR (namespace, name, uid) —
+            # extender ProcessPreemption responses round-trip pods through
+            # JSON, so id() alone would evict nothing and spin forever.
+            # Only the touched nodes' rows change → incremental re-snapshot
+            # (models.snapshot.with_pods_by_node; cache.go:194 analog); the
+            # full rebuild is the fallback when vocab/shared-claim rules
+            # prevent it.
             victim_ids = {id(v) for v in outcome.victims}
-            working_pods = [p for plist in snap.pods_by_node for p in plist
-                            if id(p) not in victim_ids]
+            victim_keys = {_pod_key(v) for v in outcome.victims}
+            new_pbn = [[p for p in plist if id(p) not in victim_ids
+                        and _pod_key(p) not in victim_keys]
+                       for plist in snap.pods_by_node]
+            changed = {i for i, plist in enumerate(snap.pods_by_node)
+                       if len(new_pbn[i]) != len(plist)}
+            if not changed and not result.placements:
+                # nothing evicted and nothing placed: the state cannot
+                # progress — stop rather than loop forever
+                break
             for idx in result.placements:
                 clone = make_clone(self.pod, clone_seq)
                 clone_seq += 1
                 clone["spec"]["nodeName"] = snap.node_names[idx]
-                working_pods.append(clone)
+                new_pbn[idx].append(clone)
+                changed.add(idx)
+            next_snap = snapshot_mod.with_pods_by_node(
+                snap, new_pbn, sorted(changed))
+            if next_snap is None:
+                next_snap = ClusterSnapshot.from_objects(
+                    snap.nodes, [p for plist in new_pbn for p in plist],
+                    **getattr(self, "_snapshot_options", {}),
+                    **{k: getattr(snap, k)
+                       for k in snapshot_mod.OBJECT_FIELDS})
+            snap = next_snap
 
         if result is None:
             result = solve_auto(encode_problem(snapshot, self.pod, profile),
@@ -196,6 +252,12 @@ class ClusterCapacity:
         no informers, goroutines, or channels exist in this design."""
         self.snapshot = None
         self._result = None
+
+
+def _pod_key(pod: dict) -> tuple:
+    meta = pod.get("metadata") or {}
+    return (meta.get("namespace") or "default", meta.get("name", ""),
+            meta.get("uid", ""))
 
 
 def _to_dict(obj):

@@ -158,12 +158,47 @@ def _cel_to_python(expr: str) -> str:
     return "".join(out)
 
 
-def cel_matches(expr: str, device: "Device") -> bool:
-    """Evaluate one CEL selector against a device.  Failed lookups and
-    evaluation errors mean 'does not match' (the reference treats runtime
-    CEL errors as a non-matching device with an event, allocator.go)."""
+_ALLOWED_CEL_NODES = (
+    "Expression", "BoolOp", "And", "Or", "UnaryOp", "Not", "USub",
+    "Compare", "Eq", "NotEq", "Lt", "LtE", "Gt", "GtE", "In", "NotIn",
+    "Attribute", "Subscript", "Name", "Load", "Constant",
+    "BinOp", "Add", "Sub", "Mult", "Div", "Mod",
+)
+
+
+def _cel_expr_safe(py_expr: str) -> bool:
+    """Static AST allowlist: selectors come from CLUSTER objects (a live
+    sync pulls anyone's ResourceClaimTemplates), so eval() must only ever
+    see comparisons over the `device` view — no calls, no dunders, no
+    other names."""
+    import ast
     try:
-        return bool(eval(_cel_to_python(expr),                # noqa: S307
+        tree = ast.parse(py_expr, mode="eval")
+    except SyntaxError:
+        return False
+    for node in ast.walk(tree):
+        if type(node).__name__ not in _ALLOWED_CEL_NODES:
+            return False
+        if isinstance(node, ast.Name) and node.id != "device":
+            return False
+        if isinstance(node, ast.Attribute) and node.attr.startswith("_"):
+            return False
+        if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+                and "__" in node.value:
+            return False
+    return True
+
+
+def cel_matches(expr: str, device: "Device") -> bool:
+    """Evaluate one CEL selector against a device.  Failed lookups,
+    evaluation errors, and expressions outside the supported subset mean
+    'does not match' (the reference treats runtime CEL errors as a
+    non-matching device with an event, allocator.go)."""
+    py_expr = _cel_to_python(expr)
+    if not _cel_expr_safe(py_expr):
+        return False
+    try:
+        return bool(eval(py_expr,                             # noqa: S307
                          {"__builtins__": {}},
                          {"device": DeviceView(device)}))
     except Exception:
@@ -383,11 +418,19 @@ def compute_slot_columns(snapshot, reqs: List[SlotRequest]
         if all_mode_empty:
             continue                    # slots stay 0 → cannot allocate
         consumes = [d.consumes for d in free]
-        k = 0
-        while k < len(free) and _fits_k_clones(k + 1, units, len(free),
-                                               consumes, pools):
-            k += 1
-        slots[i] = float(k) if units else _SLOTS_UNLIMITED
+        if not units:
+            slots[i] = _SLOTS_UNLIMITED
+            continue
+        # greedy feasibility is monotone in k (smaller k allocates a subset
+        # of the same sorted unit list) → binary search, not linear probing
+        lo, hi = 0, len(free) // max(1, len(units))
+        while lo < hi:
+            mid = (lo + hi + 1) // 2
+            if _fits_k_clones(mid, units, len(free), consumes, pools):
+                lo = mid
+            else:
+                hi = mid - 1
+        slots[i] = float(lo)
     return slots
 
 

@@ -223,6 +223,118 @@ def _batched_solve(pbs: List[enc.EncodedProblem], max_limit: int,
     return results
 
 
+def sweep_interleaved(snapshot: ClusterSnapshot, templates: Sequence[dict],
+                      profile: Optional[SchedulerProfile] = None,
+                      max_total: int = 0) -> List[sim.SolveResult]:
+    """Heterogeneous templates racing through ONE shared cluster state, the
+    way the reference's scheduling queue would run them (ROADMAP #8).
+
+    Queue semantics (backend/queue/scheduling_queue.go + PrioritySort,
+    priority_sort.go): the activeQ pops the highest-priority pod first,
+    FIFO within a priority — and because each binding enqueues the
+    template's NEXT clone at the tail, equal-priority templates interleave
+    round-robin (A0, B0, A1, B1, ...), each placement consuming shared
+    capacity.  A template whose clone goes Unschedulable leaves the queue.
+
+    This is inherently per-pod sequential (every placement changes every
+    other template's world), so it runs on the object-level oracle
+    machinery — the parity path for multi-template queue studies, not the
+    batched what-if sweep."""
+    import heapq
+
+    from ..engine import oracle
+    from ..engine.preemption import resolve_priority
+    from ..models import podspec as ps
+    from ..ops import volumes as vol_ops
+
+    profile = profile or SchedulerProfile()
+    n = snapshot.num_nodes
+    state = oracle.OracleState(snapshot)
+
+    results: List[Optional[sim.SolveResult]] = [None] * len(templates)
+    placements: List[List[int]] = [[] for _ in templates]
+    verdicts = [vol_ops.evaluate(snapshot, t, profile.filter_enabled)
+                for t in templates]
+    placed_per_node = [[0] * n for _ in templates]
+
+    heap: List[tuple] = []
+    seq = 0
+    for ti, t in enumerate(templates):
+        heapq.heappush(heap, (-resolve_priority(
+            t, snapshot.priority_classes), seq, ti))
+        seq += 1
+
+    def node_reason(ti: int, i: int) -> Optional[str]:
+        t = templates[ti]
+        r = oracle._filter_node(state, i, t, profile)
+        if r is not None:
+            return r
+        v = verdicts[ti]
+        if ps.pod_host_ports(t) and profile.filter_enabled("NodePorts") \
+                and placed_per_node[ti][i] > 0:
+            return ("node(s) didn't have free ports for the requested "
+                    "pod ports")
+        if not v.mask[i]:
+            return v.reasons[i]
+        if v.self_disk_conflict and placed_per_node[ti][i] > 0:
+            return vol_ops.REASON_DISK_CONFLICT
+        if v.rwop_self_conflict and placements[ti]:
+            return vol_ops.REASON_RWOP_CONFLICT
+        return None
+
+    total = 0
+    while heap and (not max_total or total < max_total):
+        _prio, _s, ti = heapq.heappop(heap)
+        t = templates[ti]
+        if verdicts[ti].pod_level_reason:
+            results[ti] = sim.SolveResult(
+                placements=[], placed_count=0,
+                fail_type=sim.FAIL_UNSCHEDULABLE,
+                fail_message=f"0/{n} nodes are available: "
+                             f"{verdicts[ti].pod_level_reason}.",
+                fail_counts={verdicts[ti].pod_level_reason: n},
+                node_names=snapshot.node_names)
+            continue
+        feasible = [i for i in range(n) if node_reason(ti, i) is None]
+        if not feasible:
+            reasons: Dict[str, int] = {}
+            for i in range(n):
+                r = node_reason(ti, i)
+                if r and (r.startswith("Insufficient")
+                          or r == "Too many pods"):
+                    for fr in oracle._fit_reasons(state, i, t):
+                        reasons[fr] = reasons.get(fr, 0) + 1
+                elif r:
+                    reasons[r] = reasons.get(r, 0) + 1
+            results[ti] = sim.SolveResult(
+                placements=placements[ti],
+                placed_count=len(placements[ti]),
+                fail_type=sim.FAIL_UNSCHEDULABLE,
+                fail_message=sim.format_fit_error(n, reasons),
+                fail_counts=reasons, node_names=snapshot.node_names)
+            continue
+        totals = oracle._score_nodes(state, feasible, t, profile)
+        best = max(feasible, key=lambda i: (totals[i], -i))
+        placements[ti].append(best)
+        placed_per_node[ti][best] += 1
+        clone = ps.make_clone(t, len(placements[ti]) - 1)
+        clone["spec"]["nodeName"] = snapshot.node_names[best]
+        state.pods_by_node[best].append(clone)
+        total += 1
+        heapq.heappush(heap, (_prio, seq, ti))    # next clone to the tail
+        seq += 1
+
+    for ti in range(len(templates)):
+        if results[ti] is None:                    # stopped by max_total
+            results[ti] = sim.SolveResult(
+                placements=placements[ti],
+                placed_count=len(placements[ti]),
+                fail_type=sim.FAIL_LIMIT_REACHED,
+                fail_message=f"Maximum number of pods simulated: {max_total}",
+                node_names=snapshot.node_names)
+    return results
+
+
 @functools.lru_cache(maxsize=None)
 def _batched_chunk_runner():
     import jax

@@ -257,3 +257,27 @@ def test_preempt_callable_cannot_invent_nodes():
     cc.snapshot = ClusterSnapshot.from_objects(nodes, pods)
     res = cc.run()
     assert res.placed_count == 1 and res.placements == [0]
+
+
+def test_preempt_extender_json_roundtrip_victims():
+    """Regression: an HTTP-style extender returns NEW victim dicts (JSON
+    round-trip); eviction must still work (key-based matching), no infinite
+    preemption loop."""
+    import copy
+
+    nodes = [build_test_node("n0", 1000, 4 * 1024 ** 3, 5)]
+    low = build_test_pod("low", 900, 0, node_name="n0")
+    low["spec"]["priority"] = 0
+    vip = default_pod(build_test_pod("vip", 900, 0))
+    vip["spec"]["priority"] = 10
+
+    def roundtrip(pod, node_to_victims):
+        return {n: [copy.deepcopy(p) for p in v]
+                for n, v in node_to_victims.items()}
+
+    profile = SchedulerProfile.parity()
+    profile.extenders = [ExtenderConfig(preempt_callable=roundtrip)]
+    cc = ClusterCapacity(vip, max_limit=1, profile=profile)
+    cc.snapshot = ClusterSnapshot.from_objects(nodes, [low])
+    res = cc.run()
+    assert res.placed_count == 1 and res.placements == [0]

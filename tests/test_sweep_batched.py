@@ -122,3 +122,49 @@ def test_mixed_spread_counts_one_group():
     assert padded[0].spread_hard.node_domain.shape == \
         padded[1].spread_hard.node_domain.shape
     assert cfg.spread_hard_n >= 1
+
+
+def test_interleaved_shared_state_queue():
+    """sweep_interleaved: equal-priority templates round-robin through ONE
+    shared cluster state; capacity is shared, not per-template."""
+    from cluster_capacity_tpu.parallel.sweep import sweep_interleaved
+
+    nodes = [{"metadata": {"name": f"n{i}"}, "spec": {},
+              "status": {"allocatable": {"cpu": "1000m",
+                                         "memory": str(4 * 1024 ** 3),
+                                         "pods": "20"}}} for i in range(2)]
+    snap = ClusterSnapshot.from_objects(nodes)
+    a = default_pod({"metadata": {"name": "a"}, "spec": {"containers": [
+        {"name": "c", "resources": {"requests": {"cpu": "500m"}}}]}})
+    b = default_pod({"metadata": {"name": "b"}, "spec": {"containers": [
+        {"name": "c", "resources": {"requests": {"cpu": "500m"}}}]}})
+    res = sweep_interleaved(snap, [a, b], SchedulerProfile.parity())
+    # 2 nodes x 1000m / 500m = 4 total slots SHARED between the templates:
+    # round-robin gives each template 2 (vs 4 each in the independent sweep)
+    assert res[0].placed_count == 2 and res[1].placed_count == 2
+    assert all(r.fail_type == "Unschedulable" for r in res)
+
+    # priority order: high-priority template drains first and takes all 4
+    hi = default_pod({"metadata": {"name": "hi"}, "spec": {"containers": [
+        {"name": "c", "resources": {"requests": {"cpu": "500m"}}}],
+        "priority": 10}})
+    res2 = sweep_interleaved(snap, [a, hi], SchedulerProfile.parity())
+    assert res2[1].placed_count == 4 and res2[0].placed_count == 0
+
+
+def test_interleaved_max_total():
+    from cluster_capacity_tpu.parallel.sweep import sweep_interleaved
+
+    nodes = [{"metadata": {"name": "n0"}, "spec": {},
+              "status": {"allocatable": {"cpu": "8000m",
+                                         "memory": str(16 * 1024 ** 3),
+                                         "pods": "50"}}}]
+    snap = ClusterSnapshot.from_objects(nodes)
+    a = default_pod({"metadata": {"name": "a"}, "spec": {"containers": [
+        {"name": "c", "resources": {"requests": {"cpu": "100m"}}}]}})
+    b = default_pod({"metadata": {"name": "b"}, "spec": {"containers": [
+        {"name": "c", "resources": {"requests": {"cpu": "100m"}}}]}})
+    res = sweep_interleaved(snap, [a, b], SchedulerProfile.parity(),
+                            max_total=5)
+    assert res[0].placed_count + res[1].placed_count == 5
+    assert {r.fail_type for r in res} == {"LimitReached"}
