@@ -94,7 +94,7 @@ def _make_nodes():
     return nodes
 
 
-def build_problem(with_spread: bool):
+def build_problem(with_spread: bool = False, with_ipa: bool = False):
     from cluster_capacity_tpu.engine.encode import encode_problem
     from cluster_capacity_tpu.models.podspec import default_pod
     from cluster_capacity_tpu.models.snapshot import ClusterSnapshot
@@ -112,6 +112,22 @@ def build_problem(with_spread: bool):
             "whenUnsatisfiable": "DoNotSchedule",
             "labelSelector": {"matchLabels": {"app": "bench"}},
         }]
+    if with_ipa:
+        # BASELINE config 4: the pairwise-constraint tensor path (self
+        # zone affinity keeps the greedy trace in one zone; preferred
+        # anti-affinity exercises the carried score state)
+        pod["spec"]["affinity"] = {
+            "podAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": [{
+                    "topologyKey": "topology.kubernetes.io/zone",
+                    "labelSelector": {"matchLabels": {"app": "bench"}}}]},
+            "podAntiAffinity": {
+                "preferredDuringSchedulingIgnoredDuringExecution": [{
+                    "weight": 10, "podAffinityTerm": {
+                        "topologyKey": "kubernetes.io/hostname",
+                        "labelSelector": {
+                            "matchLabels": {"app": "bench"}}}}]},
+        }
     snapshot = ClusterSnapshot.from_objects(_make_nodes())
     return encode_problem(snapshot, default_pod(pod), SchedulerProfile())
 
@@ -127,11 +143,12 @@ def bench_fast_path():
     return res.placed_count, dt
 
 
-def bench_scan_spread(platform: str):
+def bench_scan(platform: str, with_spread: bool = False,
+               with_ipa: bool = False):
     from cluster_capacity_tpu.engine import fused
     from cluster_capacity_tpu.engine import simulator as sim
 
-    pb = build_problem(with_spread=True)
+    pb = build_problem(with_spread=with_spread, with_ipa=with_ipa)
     # Steady-state throughput: a bounded run sized to the platform (the CPU
     # XLA scan is ~1000x slower per step than the fused TPU kernel).
     budget = int(os.environ.get(
@@ -153,10 +170,15 @@ def main() -> None:
     sys.stderr.write(f"bench: fast path {fp_placed} placements in "
                      f"{fp_dt:.3f}s on {platform}\n")
 
-    sc_placed, sc_dt, fused_used = bench_scan_spread(platform)
+    sc_placed, sc_dt, fused_used = bench_scan(platform, with_spread=True)
     sc_pps = sc_placed / sc_dt
     sys.stderr.write(f"bench: scan+spread {sc_placed} placements in "
                      f"{sc_dt:.3f}s on {platform} (fused={fused_used})\n")
+
+    ipa_placed, ipa_dt, ipa_fused = bench_scan(platform, with_ipa=True)
+    ipa_pps = ipa_placed / ipa_dt
+    sys.stderr.write(f"bench: scan+ipa {ipa_placed} placements in "
+                     f"{ipa_dt:.3f}s on {platform} (fused={ipa_fused})\n")
 
     print(json.dumps({
         "metric": f"full_capacity_placements_per_sec_{N_NODES}_nodes",
@@ -167,7 +189,9 @@ def main() -> None:
         "scan_engine_spread_placements_per_sec": round(sc_pps, 2),
         "scan_engine_spread_vs_baseline": round(
             sc_pps / BASELINE_PLACEMENTS_PER_SEC, 2),
+        "scan_engine_ipa_placements_per_sec": round(ipa_pps, 2),
         "scan_engine_fused_kernel": bool(fused_used),
+        "scan_engine_fused_ipa": bool(ipa_fused),
         "fast_path_seconds_for_full_estimate": round(fp_dt, 3),
         "fast_path_total_placements": fp_placed,
     }))

@@ -72,6 +72,20 @@ def build_parser(prog: str = "cluster-capacity") -> argparse.ArgumentParser:
     p.add_argument("--metrics", action="store_true",
                    help="Dump scheduler metrics (Prometheus text format) to "
                         "stderr after the run.")
+    p.add_argument("--period", type=float, default=0.0,
+                   help="Continuous mode: re-sync and re-run the analysis "
+                        "every PERIOD seconds (the reference's historical "
+                        "--period flag, doc/cluster-capacity.md). 0 = run "
+                        "once.")
+    p.add_argument("--period-iterations", dest="period_iterations", type=int,
+                   default=0, help=argparse.SUPPRESS)  # test hook: stop after N
+    p.add_argument("--interleave", action="store_true",
+                   help="With multiple --podspec: race the templates through "
+                        "ONE shared cluster state with scheduling-queue pop "
+                        "semantics (PrioritySort order) instead of "
+                        "independent what-if sweeps.  NOTE: --max-limit then "
+                        "caps the TOTAL placements across all templates "
+                        "(one queue), not each template separately.")
     return p
 
 
@@ -137,34 +151,35 @@ def run(argv: Optional[List[str]] = None, prog: str = "cluster-capacity") -> int
               file=sys.stderr)
         return 1
 
-    if len(pods) == 1:
-        cc = ClusterCapacity(pods[0], max_limit=args.max_limit,
-                             profile=profile, exclude_nodes=exclude)
-        if args.snapshot.endswith(".npz"):
-            from ..utils.checkpoint import load as load_checkpoint
-            cc.snapshot = load_checkpoint(args.snapshot)
-        elif args.snapshot:
-            objs = load_snapshot_objects(args.snapshot)
-            if args.node_order == "zone-round-robin":
-                objs["node_order"] = "zone-round-robin"
-            cc.sync_with_objects(objs.pop("nodes", []), objs.pop("pods", []),
-                                 **objs)
-        else:
-            cc.sync_with_client(_load_live_cluster(args.kubeconfig))
-        if args.save_snapshot:
-            from ..utils.checkpoint import save as save_checkpoint
-            save_checkpoint(args.save_snapshot, cc.snapshot)
-        cc.run()
-        review = cc.report()
-    else:
-        # batched what-if sweep over all templates against one snapshot
+    def one_run():
+        if len(pods) == 1:
+            cc = ClusterCapacity(pods[0], max_limit=args.max_limit,
+                                 profile=profile, exclude_nodes=exclude)
+            if args.snapshot.endswith(".npz"):
+                from ..utils.checkpoint import load as load_checkpoint
+                cc.snapshot = load_checkpoint(args.snapshot)
+            elif args.snapshot:
+                objs = load_snapshot_objects(args.snapshot)
+                if args.node_order == "zone-round-robin":
+                    objs["node_order"] = "zone-round-robin"
+                cc.sync_with_objects(objs.pop("nodes", []),
+                                     objs.pop("pods", []), **objs)
+            else:
+                cc.sync_with_client(_load_live_cluster(args.kubeconfig))
+            if args.save_snapshot:
+                from ..utils.checkpoint import save as save_checkpoint
+                save_checkpoint(args.save_snapshot, cc.snapshot)
+            cc.run()
+            return cc.report()
+
+        # multi-template run against one snapshot: independent batched
+        # what-if sweep, or --interleave for shared-state queue semantics
         from ..models.snapshot import ClusterSnapshot
-        from ..parallel.sweep import sweep
+        from ..parallel.sweep import sweep, sweep_interleaved
         from ..utils.report import build_review
-        if args.snapshot:
-            objs = load_snapshot_objects(args.snapshot)
-        else:
+        if not args.snapshot:
             raise SystemExit("multi-podspec sweeps require --snapshot")
+        objs = load_snapshot_objects(args.snapshot)
         import time
 
         from ..utils import metrics as metrics_mod
@@ -177,22 +192,37 @@ def run(argv: Optional[List[str]] = None, prog: str = "cluster-capacity") -> int
                 exclude_nodes=exclude, **objs)
         t0 = time.perf_counter()
         with default_tracer.span(SPAN_SOLVE), default_tracer.profile():
-            results = sweep(snapshot, pods, profile=profile,
-                            max_limit=args.max_limit)
+            if args.interleave:
+                results = sweep_interleaved(snapshot, pods, profile=profile,
+                                            max_total=args.max_limit)
+            else:
+                results = sweep(snapshot, pods, profile=profile,
+                                max_limit=args.max_limit)
         reg = metrics_mod.default_registry
         for r in results:
             reg.inc(metrics_mod.SCHEDULE_ATTEMPTS, amount=r.placed_count,
                     result="scheduled", profile=profile.name)
             if r.fail_type == "Unschedulable":
-                reg.inc(metrics_mod.SCHEDULE_ATTEMPTS, result="unschedulable",
-                        profile=profile.name)
+                reg.inc(metrics_mod.SCHEDULE_ATTEMPTS,
+                        result="unschedulable", profile=profile.name)
         reg.observe(metrics_mod.SCHEDULING_DURATION, time.perf_counter() - t0)
-        review = build_review(pods, results)
+        return build_review(pods, results)
 
-    print_review(review, verbose=args.verbose, fmt=args.output)
-    if args.metrics:
-        from ..utils.metrics import default_registry
-        sys.stderr.write(default_registry.render())
+    import time
+    runs = 0
+    while True:
+        review = one_run()
+        print_review(review, verbose=args.verbose, fmt=args.output)
+        if args.metrics:
+            from ..utils.metrics import default_registry
+            sys.stderr.write(default_registry.render())
+        runs += 1
+        if args.period <= 0:
+            break
+        if args.period_iterations and runs >= args.period_iterations:
+            break
+        sys.stdout.flush()
+        time.sleep(args.period)
     return 0
 
 

@@ -80,6 +80,8 @@ class ClusterCapacity:
         syncs (simulator.go:176-295) is fetched from whichever of
         (client, *extra_apis) exposes its list method — pass the AppsV1 /
         PolicyV1 / StorageV1 / SchedulingV1 API objects for full parity."""
+        import sys
+
         apis = (client,) + tuple(extra_apis)
         nodes = [_to_dict(x) for x in client.list_node().items]
         pods = [_to_dict(x) for x in client.list_pod_for_all_namespaces().items]
@@ -89,7 +91,15 @@ class ClusterCapacity:
                 fn = getattr(api, method, None)
                 if fn is None:
                     continue
-                extra[kw] = [_to_dict(x) for x in fn().items]
+                try:
+                    extra[kw] = [_to_dict(x) for x in fn().items]
+                except Exception as e:
+                    # RBAC-scoped accounts / disabled API groups: the
+                    # reference would fail the whole sync, but a nodes+pods
+                    # analysis is still meaningful — degrade with a warning
+                    sys.stderr.write(
+                        f"cluster_capacity_tpu: skipping {kw} sync "
+                        f"({type(e).__name__}: {e})\n")
                 break
         self.sync_with_objects(nodes, pods, **extra)
 

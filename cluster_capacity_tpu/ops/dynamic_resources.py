@@ -163,6 +163,7 @@ _ALLOWED_CEL_NODES = (
     "Compare", "Eq", "NotEq", "Lt", "LtE", "Gt", "GtE", "In", "NotIn",
     "Attribute", "Subscript", "Name", "Load", "Constant",
     "BinOp", "Add", "Sub", "Mult", "Div", "Mod",
+    "List", "Tuple",                 # literal containers for `in [...]`
 )
 
 

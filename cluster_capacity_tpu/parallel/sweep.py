@@ -282,10 +282,27 @@ def sweep_interleaved(snapshot: ClusterSnapshot, templates: Sequence[dict],
             return vol_ops.REASON_RWOP_CONFLICT
         return None
 
+    # deterministic sampling state per template (numFeasibleNodesToFind —
+    # the queue parity path must sample exactly like single-template runs)
+    from ..engine.simulator import _num_feasible_nodes_to_find
+    sample_k = _num_feasible_nodes_to_find(profile, n)
+    next_start = [0] * len(templates)
+
     total = 0
     while heap and (not max_total or total < max_total):
         _prio, _s, ti = heapq.heappop(heap)
         t = templates[ti]
+        if (t.get("spec") or {}).get("schedulingGates"):
+            # PreEnqueue: gated pods never enter a cycle (sim.solve parity)
+            reason = ("Scheduling is blocked due to non-empty scheduling "
+                      "gates")
+            results[ti] = sim.SolveResult(
+                placements=[], placed_count=0,
+                fail_type="SchedulingGated",
+                fail_message=f"0/{n} nodes are available: {reason}.",
+                fail_counts={reason: n},
+                node_names=snapshot.node_names)
+            continue
         if verdicts[ti].pod_level_reason:
             results[ti] = sim.SolveResult(
                 placements=[], placed_count=0,
@@ -313,8 +330,15 @@ def sweep_interleaved(snapshot: ClusterSnapshot, templates: Sequence[dict],
                 fail_message=sim.format_fit_error(n, reasons),
                 fail_counts=reasons, node_names=snapshot.node_names)
             continue
-        totals = oracle._score_nodes(state, feasible, t, profile)
-        best = max(feasible, key=lambda i: (totals[i], -i))
+        scorable = feasible
+        if sample_k > 0:
+            by_rank = sorted(feasible,
+                             key=lambda i: (i - next_start[ti]) % n)
+            scorable = by_rank[:sample_k]
+            last_rank = (scorable[-1] - next_start[ti]) % n
+            next_start[ti] = (next_start[ti] + min(last_rank + 1, n)) % n
+        totals = oracle._score_nodes(state, scorable, t, profile)
+        best = max(scorable, key=lambda i: (totals[i], -i))
         placements[ti].append(best)
         placed_per_node[ti][best] += 1
         clone = ps.make_clone(t, len(placements[ti]) - 1)

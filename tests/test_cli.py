@@ -89,3 +89,52 @@ def test_snapshot_checkpoint_roundtrip_cli(tmp_path):
                                       "--snapshot", ckpt])
     assert rc2 == 0
     assert out2.strip() == "52"
+
+
+def test_period_continuous_mode(tmp_path, capsys):
+    """--period re-syncs and re-runs (the reference's historical --period
+    continuous mode); snapshot edits between rounds are picked up."""
+    import json
+    from cluster_capacity_tpu.cli.cluster_capacity import run
+
+    snap = {"nodes": [{"metadata": {"name": "n0"}, "spec": {},
+                       "status": {"allocatable": {"cpu": "1",
+                                                  "memory": "4Gi",
+                                                  "pods": "10"}}}]}
+    sp = tmp_path / "snap.json"
+    sp.write_text(json.dumps(snap))
+    podf = tmp_path / "pod.yaml"
+    podf.write_text("metadata:\n  name: p\nspec:\n  containers:\n"
+                    "  - name: c\n    resources:\n      requests:\n"
+                    "        cpu: 500m\n")
+    rc = run(["--podspec", str(podf), "--snapshot", str(sp),
+              "--verbose", "--period", "0.01", "--period-iterations", "2"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert out.count("can schedule 2 instance(s)") == 2
+
+
+def test_interleave_flag(tmp_path, capsys):
+    import json
+    from cluster_capacity_tpu.cli.cluster_capacity import run
+
+    snap = {"nodes": [{"metadata": {"name": "n0"}, "spec": {},
+                       "status": {"allocatable": {"cpu": "1",
+                                                  "memory": "4Gi",
+                                                  "pods": "10"}}}]}
+    sp = tmp_path / "snap.json"
+    sp.write_text(json.dumps(snap))
+    pa = tmp_path / "a.yaml"
+    pa.write_text("metadata:\n  name: a\nspec:\n  containers:\n"
+                  "  - name: c\n    resources:\n      requests:\n"
+                  "        cpu: 500m\n")
+    pb = tmp_path / "b.yaml"
+    pb.write_text("metadata:\n  name: b\nspec:\n  containers:\n"
+                  "  - name: c\n    resources:\n      requests:\n"
+                  "        cpu: 500m\n")
+    rc = run(["--podspec", str(pa), "--podspec", str(pb),
+              "--snapshot", str(sp), "--interleave", "--verbose"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    # 1000m / 500m = 2 slots SHARED: one each under round-robin
+    assert out.count("can schedule 1 instance(s)") == 2

@@ -168,3 +168,33 @@ def test_interleaved_max_total():
                             max_total=5)
     assert res[0].placed_count + res[1].placed_count == 5
     assert {r.fail_type for r in res} == {"LimitReached"}
+
+
+def test_interleaved_scheduling_gates_and_sampling():
+    """Regression: gated templates never place in --interleave mode, and
+    sampling applies per template exactly as in single-template runs."""
+    from cluster_capacity_tpu.engine import oracle
+    from cluster_capacity_tpu.parallel.sweep import sweep_interleaved
+
+    nodes = [{"metadata": {"name": f"n{i:03d}"}, "spec": {},
+              "status": {"allocatable": {"cpu": "2000m",
+                                         "memory": str(8 * 1024 ** 3),
+                                         "pods": "10"}}} for i in range(120)]
+    snap = ClusterSnapshot.from_objects(nodes)
+    gated = default_pod({"metadata": {"name": "g"}, "spec": {
+        "containers": [{"name": "c", "resources": {
+            "requests": {"cpu": "100m"}}}],
+        "schedulingGates": [{"name": "wait"}]}})
+    plain = default_pod({"metadata": {"name": "p"}, "spec": {
+        "containers": [{"name": "c", "resources": {
+            "requests": {"cpu": "100m"}}}]}})
+    profile = SchedulerProfile.parity()
+    profile.percentage_of_nodes_to_score = 90
+
+    res = sweep_interleaved(snap, [gated, plain], profile, max_total=30)
+    assert res[0].placed_count == 0
+    assert res[0].fail_type == "SchedulingGated"
+    # with a single non-gated template, interleaved == oracle.simulate
+    # (same rotating sampling window)
+    expected, _ = oracle.simulate(snap, plain, profile, max_limit=30)
+    assert res[1].placements == expected
