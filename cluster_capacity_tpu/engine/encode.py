@@ -57,6 +57,11 @@ STATIC_REASONS = {
 from ..ops.dynamic_resources import REASON_CANNOT_ALLOCATE as _DRA_REASON
 STATIC_REASONS[CODE_DRA] = _DRA_REASON
 
+# PreEnqueue gate wording (kubelet's condition message; single source for
+# the engine, oracle, and interleaved sweep)
+REASON_SCHEDULING_GATED = ("Scheduling is blocked due to non-empty "
+                           "scheduling gates")
+
 
 @dataclass
 class EncodedProblem:
@@ -289,8 +294,7 @@ def encode_problem(snapshot: ClusterSnapshot, pod: dict,
     elif dra_missing_class:
         pod_level_reason = dra.REASON_CANNOT_ALLOCATE
     if (pod.get("spec") or {}).get("schedulingGates"):
-        pod_level_reason = ("Scheduling is blocked due to non-empty "
-                            "scheduling gates")
+        pod_level_reason = REASON_SCHEDULING_GATED
         pod_level_fail_type = "SchedulingGated"
 
     # --- static scores ------------------------------------------------------

@@ -12,15 +12,17 @@ supported configuration family (validated by tests/test_fused.py):
 - deterministic mode, float32 (the TPU fast path; f64 parity stays on XLA)
 - NodeResourcesFit filter + Least/MostAllocated scoring, balanced allocation
 - TaintToleration / NodeAffinity / ImageLocality static scores + normalize
-- PodTopologySpread HARD constraints (the carried-state filter)
+- PodTopologySpread HARD constraints (the carried-state filter) and SOFT
+  scoring (incl. system-default spreading; distinct-domain counting unrolls
+  over the small zone vocabulary)
 - InterPodAffinity: all three probes, escape hatch, preferred-term scoring
 - deterministic numFeasibleNodesToFind sampling (binary-searched threshold)
 - NodePorts / volume / DRA clone self-conflict gates
 
-Unsupported (falls back to the XLA scan): f64 parity mode, soft-spread
-scoring (cross-domain presence counting), RequestedToCapacityRatio shapes,
-randomized tie-break.  Reference hot path being replaced:
-vendor/k8s.io/kubernetes/pkg/scheduler/schedule_one.go:610-694.
+Unsupported (falls back to the XLA scan): f64 parity mode, soft constraints
+over large domain vocabularies (> _SOFT_DOMAIN_CAP non-hostname values),
+RequestedToCapacityRatio shapes, randomized tie-break.  Reference hot path
+being replaced: vendor/k8s.io/kubernetes/pkg/scheduler/schedule_one.go:610-694.
 
 Array layout: every per-node tensor becomes one [S, 128] f32 "plane"
 (S = ceil(N/128) sublane rows); planes stack into a single [P, S, 128] VMEM
@@ -49,6 +51,8 @@ MAX_NODES = 65536
 MAX_R = 16
 MAX_SPREAD = 4
 MAX_GROUPS = 4
+# Soft constraints unroll the distinct-domain count over D values — cap it.
+_SOFT_DOMAIN_CAP = 32
 
 
 class KernelMeta(NamedTuple):
@@ -68,6 +72,11 @@ class KernelMeta(NamedTuple):
     sh_mindom: Tuple[float, ...]
     sh_domnum: Tuple[float, ...]
     sh_self: Tuple[bool, ...]
+    cs: int                     # soft-spread constraint row count
+    ss_skew: Tuple[float, ...]
+    ss_self: Tuple[bool, ...]
+    ss_host: Tuple[bool, ...]
+    ss_dnh: Tuple[int, ...]     # per-row non-hostname domain count (0 = host)
     ghas_aff: Tuple[bool, ...]
     ghas_anti: Tuple[bool, ...]
     aff_ginc: Tuple[float, ...]
@@ -95,7 +104,13 @@ def eligible(cfg: sim.StaticConfig, pb) -> bool:
     if cfg.dtype64 or not cfg.deterministic:
         return False
     if cfg.spread_soft_n > 0:
-        return False
+        ss = pb.spread_soft
+        if ss.node_domain.shape[0] > MAX_SPREAD:
+            return False
+        for c in range(ss.num_constraints):
+            if not ss.is_hostname[c] and (ss.node_domain[c] >= 0).any() \
+                    and int(ss.node_domain[c].max()) + 1 > _SOFT_DOMAIN_CAP:
+                return False
     if cfg.fit_strategy_type == "RequestedToCapacityRatio":
         return False
     n = pb.snapshot.num_nodes
@@ -151,6 +166,15 @@ def _pack_meta(cfg: sim.StaticConfig, pb, consts) -> _Packing:
         tuple(x.item() for x in arr) for arr in group_fold(ipa))
 
     sh = pb.spread_hard
+    ss = pb.spread_soft
+    cs = ss.node_domain.shape[0]
+    ss_dnh = []
+    for c in range(cs):
+        if c < ss.num_constraints and not ss.is_hostname[c] \
+                and (ss.node_domain[c] >= 0).any():
+            ss_dnh.append(int(ss.node_domain[c].max()) + 1)
+        else:
+            ss_dnh.append(0)
     meta = KernelMeta(
         n=n, s=s, r=r, cfg=cfg,
         req_vec=tuple(float(x) for x in pb.req_vec),
@@ -163,6 +187,11 @@ def _pack_meta(cfg: sim.StaticConfig, pb, consts) -> _Packing:
         sh_mindom=tuple(float(x) for x in sh.min_domains),
         sh_domnum=tuple(float(x) for x in sh.domain_valid.sum(axis=1)),
         sh_self=tuple(bool(x) for x in sh.self_match),
+        cs=cs,
+        ss_skew=tuple(float(x) for x in ss.max_skew),
+        ss_self=tuple(bool(x) for x in ss.self_match),
+        ss_host=tuple(bool(x) for x in ss.is_hostname),
+        ss_dnh=tuple(ss_dnh),
         ghas_aff=tuple(ghas_aff), ghas_anti=tuple(ghas_anti),
         aff_ginc=tuple(aff_ginc), anti_ginc=tuple(anti_ginc),
         pref_gw=tuple(pref_gw), g=g, ch=ch,
@@ -186,6 +215,11 @@ def _pack_meta(cfg: sim.StaticConfig, pb, consts) -> _Packing:
         const_names += [f"sh_dom{c}" for c in range(ch)]
         const_names += [f"sh_countable{c}" for c in range(ch)]
         const_names.append("sh_missing")
+    if cfg.spread_soft_n > 0:
+        const_names += [f"ss_dom{c}" for c in range(meta.cs)]
+        const_names += [f"ss_countable{c}" for c in range(meta.cs)]
+        const_names += [f"ss_existing{c}" for c in range(meta.cs)]
+        const_names.append("ss_ignored")
     if cfg.ipa_filter_on or cfg.ipa_num_aff or cfg.ipa_num_anti \
             or cfg.ipa_num_pref:
         const_names += [f"ipa_dom{gi}" for gi in range(g)]
@@ -200,6 +234,8 @@ def _pack_meta(cfg: sim.StaticConfig, pb, consts) -> _Packing:
     carry_names += ["nonzero0", "nonzero1", "placed"]
     if cfg.spread_hard_n > 0:
         carry_names += [f"sh_cnt{c}" for c in range(ch)]
+    if cfg.spread_soft_n > 0:
+        carry_names += [f"ss_cnt{c}" for c in range(meta.cs)]
     if cfg.ipa_num_aff > 0 or cfg.ipa_filter_on:
         carry_names += [f"aff_cnt{gi}" for gi in range(g)]
     if cfg.ipa_num_anti > 0 or cfg.ipa_filter_on:
@@ -239,6 +275,16 @@ def _pack_consts(pk: _Packing, consts) -> np.ndarray:
             put(f"sh_countable{c}", countable[c])
         put("sh_missing", np.asarray(consts["sh_missing"], dtype=np.float32),
             fill=1.0)
+    if cfg.spread_soft_n > 0:
+        dom = np.asarray(consts["ss_dom"], dtype=np.float32)
+        countable = np.asarray(consts["ss_countable"], dtype=np.float32)
+        existing = np.asarray(consts["ss_node_existing"], dtype=np.float32)
+        for c in range(meta.cs):
+            put(f"ss_dom{c}", dom[c], fill=-1.0)
+            put(f"ss_countable{c}", countable[c])
+            put(f"ss_existing{c}", existing[c])
+        put("ss_ignored", np.asarray(consts["ss_ignored"], dtype=np.float32),
+            fill=1.0)
     if any(k.startswith("ipa_dom") for k in pk.const_idx):
         dom = np.asarray(consts["ipa_dom"], dtype=np.float32)
         for gi in range(meta.g):
@@ -271,10 +317,14 @@ def _pack_carry(pk: _Packing, carry: sim.Carry) -> Tuple[np.ndarray, np.ndarray]
     put("nonzero0", nz[:, 0])
     put("nonzero1", nz[:, 1])
     put("placed", np.asarray(carry.placed, dtype=np.float32))
-    if f"sh_cnt0" in pk.carry_idx:
+    if "sh_cnt0" in pk.carry_idx:
         cnt = np.asarray(carry.sh_cnt)
         for c in range(meta.ch):
             put(f"sh_cnt{c}", cnt[c])
+    if "ss_cnt0" in pk.carry_idx:
+        cnt = np.asarray(carry.ss_cnt)
+        for c in range(meta.cs):
+            put(f"ss_cnt{c}", cnt[c])
     for stem, arr in (("aff_cnt", carry.aff_cnt), ("anti_cnt", carry.anti_cnt),
                       ("pref_cnt", carry.pref_cnt)):
         if f"{stem}0" in pk.carry_idx:
@@ -312,6 +362,8 @@ def _unpack_carry(pk: _Packing, planes: np.ndarray, scalars: np.ndarray,
         placed=jnp.asarray(placed),
         sh_cnt=jnp.asarray(rows("sh_cnt", meta.ch), dtype=dt)
         if "sh_cnt0" in pk.carry_idx else template.sh_cnt,
+        ss_cnt=jnp.asarray(rows("ss_cnt", meta.cs), dtype=dt)
+        if "ss_cnt0" in pk.carry_idx else template.ss_cnt,
         aff_cnt=jnp.asarray(rows("aff_cnt", meta.g), dtype=dt)
         if "aff_cnt0" in pk.carry_idx else template.aff_cnt,
         anti_cnt=jnp.asarray(rows("anti_cnt", meta.g), dtype=dt)
@@ -527,6 +579,42 @@ def _build_kernel(pk: _Packing, k_steps: int):
             if w:
                 total = total + w * jnp.where(scorable, C["il_score"], 0.0)
 
+            w = sim._weight(cfg, "PodTopologySpread")
+            if w and cfg.spread_soft_n > 0:
+                ssc = scorable & ~(C["ss_ignored"] > 0.5)
+                raw = jnp.zeros((s, LANES), dtype=jnp.float32)
+                host_size = jnp.sum(ssc.astype(jnp.float32))
+                for c in range(meta.cs):
+                    dom = C[f"ss_dom{c}"]
+                    has_key = dom >= 0
+                    if meta.ss_host[c]:
+                        cnt = C[f"ss_existing{c}"]
+                        if meta.ss_self[c]:
+                            cnt = cnt + Y[yi["placed"]]
+                        size = host_size
+                    else:
+                        cnt = Y[yi[f"ss_cnt{c}"]]
+                        # distinct domains among scorable nodes, unrolled
+                        # over the (small) zone vocabulary
+                        size = jnp.zeros((), dtype=jnp.float32)
+                        for d in range(meta.ss_dnh[c]):
+                            size = size + jnp.any(
+                                ssc & (dom == d)).astype(jnp.float32)
+                    tp = jnp.log(size + 2.0)
+                    raw = raw + jnp.where(
+                        has_key, cnt * tp + (meta.ss_skew[c] - 1.0), 0.0)
+                raw = jnp.round(raw)
+                any_sc = jnp.any(ssc)
+                max_s = jnp.max(jnp.where(ssc, raw, -jnp.inf))
+                min_s = jnp.min(jnp.where(ssc, raw, jnp.inf))
+                max_s = jnp.where(any_sc, max_s, 0.0)
+                min_s = jnp.where(any_sc, min_s, 0.0)
+                out = jnp.where(
+                    max_s == 0, 100.0,
+                    jnp.floor(100.0 * (max_s + min_s - raw)
+                              / jnp.maximum(max_s, 1e-30)))
+                total = total + w * jnp.where(ssc, out, 0.0)
+
             w = sim._weight(cfg, "InterPodAffinity")
             if w and cfg.ipa_score_active:
                 raw = C["ipa_static_pref"] if meta.has_static_pref \
@@ -585,6 +673,17 @@ def _build_kernel(pk: _Packing, k_steps: int):
                     inc = countable_ch * gate
                     hit = (dom == dom_ch) & (dom >= 0)
                     Y2[yi[f"sh_cnt{c}"]] = Y[yi[f"sh_cnt{c}"]] \
+                        + hit.astype(jnp.float32) * inc
+            if cfg.spread_soft_n > 0:
+                for c in range(meta.cs):
+                    if not meta.ss_self[c]:
+                        continue
+                    dom = C[f"ss_dom{c}"]
+                    dom_ch = jnp.sum(onehot * dom)
+                    countable_ch = jnp.sum(onehot * C[f"ss_countable{c}"])
+                    inc = countable_ch * gate
+                    hit = (dom == dom_ch) & (dom >= 0)
+                    Y2[yi[f"ss_cnt{c}"]] = Y[yi[f"ss_cnt{c}"]] \
                         + hit.astype(jnp.float32) * inc
 
             new_aff_total = aff_total

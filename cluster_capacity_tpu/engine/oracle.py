@@ -589,6 +589,25 @@ def _ipa_scores(state: OracleState, feasible: List[int],
 
 # --- Main loop --------------------------------------------------------------
 
+def sample_window(feasible: List[int], n: int, sample_k: int,
+                  next_start: int):
+    """findNodesThatPassFilters truncation (schedule_one.go:610-694): take
+    the first sample_k feasible nodes in round-robin order from next_start,
+    advancing the start past the LAST NODE EXAMINED — the k-th feasible
+    node's position when k were found, or all n nodes (advance ≡ 0 mod n)
+    when fewer than k exist.  Single source for the oracle and the
+    interleaved queue sweep; the engine's scan step mirrors it exactly
+    (simulator._step)."""
+    if sample_k <= 0:
+        return feasible, next_start
+    if len(feasible) < sample_k:
+        return list(feasible), next_start      # processed all n nodes
+    by_rank = sorted(feasible, key=lambda i: (i - next_start) % n)
+    scorable = by_rank[:sample_k]
+    last_rank = (scorable[-1] - next_start) % n
+    return scorable, (next_start + last_rank + 1) % n
+
+
 def simulate_with_preemption(snapshot: ClusterSnapshot, template: dict,
                              profile: Optional[SchedulerProfile] = None,
                              max_limit: int = 0,
@@ -649,8 +668,8 @@ def simulate(snapshot: ClusterSnapshot, template: dict,
     n = snapshot.num_nodes
 
     if (template.get("spec") or {}).get("schedulingGates"):
-        return [], {"Scheduling is blocked due to non-empty scheduling "
-                    "gates": n}
+        from .encode import REASON_SCHEDULING_GATED
+        return [], {REASON_SCHEDULING_GATED: n}
     verdict = vol_ops.evaluate(snapshot, template, profile.filter_enabled)
     if verdict.pod_level_reason:
         return [], {verdict.pod_level_reason: n}
@@ -692,12 +711,8 @@ def simulate(snapshot: ClusterSnapshot, template: dict,
                 elif r:
                     reasons[r] = reasons.get(r, 0) + 1
             return placements, reasons
-        scorable = feasible
-        if sample_k > 0:
-            by_rank = sorted(feasible, key=lambda i: (i - next_start) % n)
-            scorable = by_rank[:sample_k]
-            last_rank = (scorable[-1] - next_start) % n
-            next_start = (next_start + min(last_rank + 1, n)) % n
+        scorable, next_start = sample_window(feasible, n, sample_k,
+                                             next_start)
         totals = _score_nodes(state, scorable, template, profile)
         best = max(scorable, key=lambda i: (totals[i], -i))
         placements.append(best)

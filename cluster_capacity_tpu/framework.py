@@ -87,20 +87,23 @@ class ClusterCapacity:
         pods = [_to_dict(x) for x in client.list_pod_for_all_namespaces().items]
         extra = {}
         for method, kw in self._SYNC_METHODS:
+            last_err = None
             for api in apis:
                 fn = getattr(api, method, None)
                 if fn is None:
                     continue
                 try:
                     extra[kw] = [_to_dict(x) for x in fn().items]
+                    break
                 except Exception as e:
-                    # RBAC-scoped accounts / disabled API groups: the
-                    # reference would fail the whole sync, but a nodes+pods
-                    # analysis is still meaningful — degrade with a warning
-                    sys.stderr.write(
-                        f"cluster_capacity_tpu: skipping {kw} sync "
-                        f"({type(e).__name__}: {e})\n")
-                break
+                    last_err = e         # try the next api exposing it
+            if last_err is not None and kw not in extra:
+                # RBAC-scoped accounts / disabled API groups: the reference
+                # would fail the whole sync, but a nodes+pods analysis is
+                # still meaningful — degrade with a warning
+                sys.stderr.write(
+                    f"cluster_capacity_tpu: skipping {kw} sync "
+                    f"({type(last_err).__name__}: {last_err})\n")
         self.sync_with_objects(nodes, pods, **extra)
 
     def run(self) -> SolveResult:

@@ -294,8 +294,7 @@ def sweep_interleaved(snapshot: ClusterSnapshot, templates: Sequence[dict],
         t = templates[ti]
         if (t.get("spec") or {}).get("schedulingGates"):
             # PreEnqueue: gated pods never enter a cycle (sim.solve parity)
-            reason = ("Scheduling is blocked due to non-empty scheduling "
-                      "gates")
+            reason = enc.REASON_SCHEDULING_GATED
             results[ti] = sim.SolveResult(
                 placements=[], placed_count=0,
                 fail_type="SchedulingGated",
@@ -330,13 +329,8 @@ def sweep_interleaved(snapshot: ClusterSnapshot, templates: Sequence[dict],
                 fail_message=sim.format_fit_error(n, reasons),
                 fail_counts=reasons, node_names=snapshot.node_names)
             continue
-        scorable = feasible
-        if sample_k > 0:
-            by_rank = sorted(feasible,
-                             key=lambda i: (i - next_start[ti]) % n)
-            scorable = by_rank[:sample_k]
-            last_rank = (scorable[-1] - next_start[ti]) % n
-            next_start[ti] = (next_start[ti] + min(last_rank + 1, n)) % n
+        scorable, next_start[ti] = oracle.sample_window(
+            feasible, n, sample_k, next_start[ti])
         totals = oracle._score_nodes(state, scorable, t, profile)
         best = max(scorable, key=lambda i: (totals[i], -i))
         placements[ti].append(best)

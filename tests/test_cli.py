@@ -91,27 +91,40 @@ def test_snapshot_checkpoint_roundtrip_cli(tmp_path):
     assert out2.strip() == "52"
 
 
-def test_period_continuous_mode(tmp_path, capsys):
+def test_period_continuous_mode(tmp_path, capsys, monkeypatch):
     """--period re-syncs and re-runs (the reference's historical --period
     continuous mode); snapshot edits between rounds are picked up."""
     import json
+    import time as time_mod
     from cluster_capacity_tpu.cli.cluster_capacity import run
 
-    snap = {"nodes": [{"metadata": {"name": "n0"}, "spec": {},
-                       "status": {"allocatable": {"cpu": "1",
-                                                  "memory": "4Gi",
-                                                  "pods": "10"}}}]}
+    def snap_with_cpu(cpu):
+        return {"nodes": [{"metadata": {"name": "n0"}, "spec": {},
+                           "status": {"allocatable": {"cpu": cpu,
+                                                      "memory": "4Gi",
+                                                      "pods": "10"}}}]}
     sp = tmp_path / "snap.json"
-    sp.write_text(json.dumps(snap))
+    sp.write_text(json.dumps(snap_with_cpu("1")))
     podf = tmp_path / "pod.yaml"
     podf.write_text("metadata:\n  name: p\nspec:\n  containers:\n"
                     "  - name: c\n    resources:\n      requests:\n"
                     "        cpu: 500m\n")
+
+    # grow the cluster between rounds through the sleep hook — the second
+    # round must observe the edit (re-sync, not a cached snapshot)
+    real_sleep = time_mod.sleep
+
+    def sleep_and_grow(seconds):
+        sp.write_text(json.dumps(snap_with_cpu("2")))
+        real_sleep(0)
+
+    monkeypatch.setattr(time_mod, "sleep", sleep_and_grow)
     rc = run(["--podspec", str(podf), "--snapshot", str(sp),
               "--verbose", "--period", "0.01", "--period-iterations", "2"])
     assert rc == 0
     out = capsys.readouterr().out
-    assert out.count("can schedule 2 instance(s)") == 2
+    assert out.count("can schedule 2 instance(s)") == 1
+    assert out.count("can schedule 4 instance(s)") == 1
 
 
 def test_interleave_flag(tmp_path, capsys):

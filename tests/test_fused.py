@@ -198,3 +198,146 @@ def test_runtime_mismatch_disables(monkeypatch):
                                verify_against=(consts, carry, 48))
     assert runner is None and fused._failed_metas
     fused._failed_metas.clear()
+
+
+def _fuzz_pod_f32(rng):
+    """Kernel-eligible mixed-family pod: fit + taints + hard AND soft
+    spread + IPA."""
+    pod = {"metadata": {"name": "t", "labels": {"app": str(rng.choice(
+        ["web", "db", "cache"]))}},
+        "spec": {"containers": [{"name": "c", "resources": {"requests": {
+            "cpu": f"{int(rng.choice([100, 300, 700]))}m",
+            "memory": str(int(rng.choice([128, 512])) * 1024 ** 2)}}}]}}
+    if rng.rand() < 0.5:
+        pod["spec"]["topologySpreadConstraints"] = [{
+            "maxSkew": int(rng.choice([1, 2])),
+            "topologyKey": str(rng.choice(["topology.kubernetes.io/zone",
+                                           "kubernetes.io/hostname"])),
+            "whenUnsatisfiable": str(rng.choice(["DoNotSchedule",
+                                                 "ScheduleAnyway"])),
+            "labelSelector": {"matchLabels": dict(pod["metadata"]["labels"])}}]
+    aff = {}
+    if rng.rand() < 0.3:
+        aff["podAffinity"] = {
+            "requiredDuringSchedulingIgnoredDuringExecution": [{
+                "topologyKey": "topology.kubernetes.io/zone",
+                "labelSelector": {"matchLabels": {
+                    "app": str(rng.choice(["web", "db"]))}}}]}
+    if rng.rand() < 0.3:
+        aff["podAntiAffinity"] = {
+            "requiredDuringSchedulingIgnoredDuringExecution": [{
+                "topologyKey": "kubernetes.io/hostname",
+                "labelSelector": {"matchLabels": {
+                    "app": str(rng.choice(["web", "db"]))}}}]}
+    if aff:
+        pod["spec"]["affinity"] = aff
+    if rng.rand() < 0.3:
+        pod["spec"]["tolerations"] = [{"key": "dedicated",
+                                       "operator": "Exists"}]
+    return pod
+
+
+def _run_fused_fuzz(seed):
+    rng = np.random.RandomState(seed)
+    nodes = _nodes(int(rng.choice([12, 24, 40])), seed=seed,
+                   zones=int(rng.choice([3, 4])), taints=bool(rng.rand() < 0.5))
+    profile = SchedulerProfile()          # float32 — kernel-eligible
+    if rng.rand() < 0.3:
+        profile.percentage_of_nodes_to_score = int(rng.choice([40, 70]))
+    pod = _fuzz_pod_f32(rng)
+    snap = ClusterSnapshot.from_objects(
+        nodes, namespaces=[{"metadata": {"name": "default"}}])
+    pb = enc.encode_problem(snap, default_pod(pod), profile)
+    cfg = sim.static_config(pb)
+    if not (cfg.deterministic and not cfg.dtype64):
+        return
+
+    os.environ["CC_TPU_FUSED"] = "1"
+    fused._failed_metas.clear()
+    try:
+        r_fused = sim.solve(pb, max_limit=60, chunk_size=64)
+        assert not fused._failed_metas, f"seed {seed}: kernel diverged"
+    finally:
+        os.environ["CC_TPU_FUSED"] = "0"
+    r_xla = sim.solve(pb, max_limit=60, chunk_size=64)
+    os.environ.pop("CC_TPU_FUSED", None)
+    assert r_fused.placements == r_xla.placements, f"seed {seed}"
+    assert r_fused.fail_message == r_xla.fail_message, f"seed {seed}"
+
+
+@pytest.mark.parametrize("seed", range(7000, 7006))
+def test_fused_fuzz_slice(seed):
+    _run_fused_fuzz(seed)
+
+
+@pytest.mark.fuzz
+@pytest.mark.parametrize("seed", range(7100, 7160))
+def test_fused_fuzz_full(seed):
+    _run_fused_fuzz(seed)
+
+
+def test_soft_spread_scoring():
+    """Soft (ScheduleAnyway) spread scoring in the kernel: zone + hostname
+    constraints, carried counts + distinct-domain sizing."""
+    pod = {"metadata": {"name": "p", "labels": {"app": "soft"}}, "spec": {
+        "containers": [{"name": "c", "resources": {
+            "requests": {"cpu": "400m"}}}],
+        "topologySpreadConstraints": [
+            {"maxSkew": 1, "topologyKey": "topology.kubernetes.io/zone",
+             "whenUnsatisfiable": "ScheduleAnyway",
+             "labelSelector": {"matchLabels": {"app": "soft"}}},
+            {"maxSkew": 2, "topologyKey": "kubernetes.io/hostname",
+             "whenUnsatisfiable": "ScheduleAnyway",
+             "labelSelector": {"matchLabels": {"app": "soft"}}}]}}
+    r = _solve_both(_nodes(24, zones=3), pod)
+    assert r.placed_count > 0
+    # soft zone spreading must actually spread across the 3 zones
+    zones = {i % 3 for i in r.placements[:3]}
+    assert len(zones) == 3
+
+
+def test_soft_and_hard_spread_mixed():
+    pod = {"metadata": {"name": "p", "labels": {"app": "mix"}}, "spec": {
+        "containers": [{"name": "c", "resources": {
+            "requests": {"cpu": "600m"}}}],
+        "topologySpreadConstraints": [
+            {"maxSkew": 2, "topologyKey": "topology.kubernetes.io/zone",
+             "whenUnsatisfiable": "DoNotSchedule",
+             "labelSelector": {"matchLabels": {"app": "mix"}}},
+            {"maxSkew": 1, "topologyKey": "topology.kubernetes.io/zone",
+             "whenUnsatisfiable": "ScheduleAnyway",
+             "labelSelector": {"matchLabels": {"app": "mix"}}}]}}
+    _solve_both(_nodes(30, zones=5), pod)
+
+
+def test_system_default_spreading():
+    """Service-selected pods with no explicit constraints get the system
+    default soft spreading (zone skew 3, hostname skew 5) — the common
+    real-cluster shape the kernel must cover."""
+    pod = {"metadata": {"name": "p", "labels": {"app": "svc"},
+                        "namespace": "default"},
+           "spec": {"containers": [{"name": "c", "resources": {
+               "requests": {"cpu": "300m"}}}]}}
+    profile = SchedulerProfile()
+    snap = ClusterSnapshot.from_objects(
+        _nodes(20, zones=4),
+        services=[{"metadata": {"name": "s", "namespace": "default"},
+                   "spec": {"selector": {"app": "svc"}}}],
+        namespaces=[{"metadata": {"name": "default"}}])
+    pb = enc.encode_problem(snap, default_pod(pod), profile)
+    cfg = sim.static_config(pb)
+
+    os.environ["CC_TPU_FUSED"] = "1"
+    fused._failed_metas.clear()
+    chunks_before = fused.STATS["chunks"]
+    try:
+        assert fused.eligible(cfg, pb)
+        r_fused = sim.solve(pb, max_limit=40, chunk_size=128)
+        assert not fused._failed_metas
+        assert fused.STATS["chunks"] > chunks_before
+    finally:
+        os.environ["CC_TPU_FUSED"] = "0"
+    r_xla = sim.solve(pb, max_limit=40, chunk_size=128)
+    os.environ.pop("CC_TPU_FUSED", None)
+    assert r_fused.placements == r_xla.placements
+    assert r_fused.fail_message == r_xla.fail_message

@@ -160,3 +160,31 @@ def test_differential_system_default_spread():
         got = sim.solve(enc.encode_problem(snapshot, pod, profile),
                         max_limit=30)
         assert got.placements == expected, f"seed {seed}"
+
+
+def test_differential_sampling_fewer_feasible_than_k():
+    """Regression: when fewer feasible nodes than numFeasibleNodesToFind
+    remain, the scheduler scans ALL nodes, so the rotating start index
+    advances by n (a no-op mod n) — not past the last feasible node
+    (schedule_one.go:610-694).  Two zones + maxSkew=1 force feasibility
+    below sample_k on alternating steps."""
+    rng = np.random.RandomState(77)
+    nodes = []
+    for i in range(120):
+        nodes.append(build_test_node(
+            f"n{i:03d}", int(rng.choice([1000, 2000, 4000])),
+            int(rng.choice([2, 4])) * 1024 ** 3, 20,
+            labels={"kubernetes.io/hostname": f"n{i:03d}",
+                    "topology.kubernetes.io/zone": f"z{i % 2}"}))
+    pod = default_pod(build_test_pod("t", 200, 128 * 1024 ** 2,
+                                     labels={"app": "s"}))
+    pod["spec"]["topologySpreadConstraints"] = [{
+        "maxSkew": 1, "topologyKey": "topology.kubernetes.io/zone",
+        "whenUnsatisfiable": "DoNotSchedule",
+        "labelSelector": {"matchLabels": {"app": "s"}}}]
+    snapshot = ClusterSnapshot.from_objects(nodes)
+    profile = SchedulerProfile.parity()
+    profile.percentage_of_nodes_to_score = 85   # k = 102 > 60 per zone
+    expected, _ = oracle.simulate(snapshot, pod, profile, max_limit=80)
+    got = sim.solve(enc.encode_problem(snapshot, pod, profile), max_limit=80)
+    assert got.placements == expected
