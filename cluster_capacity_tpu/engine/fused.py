@@ -21,8 +21,8 @@ supported configuration family (validated by tests/test_fused.py):
 
 Unsupported (falls back to the XLA scan): f64 parity mode, soft constraints
 over large domain vocabularies (> _SOFT_DOMAIN_CAP non-hostname values),
-RequestedToCapacityRatio shapes, randomized tie-break.  Reference hot path
-being replaced: vendor/k8s.io/kubernetes/pkg/scheduler/schedule_one.go:610-694.
+randomized tie-break.  Reference hot path being replaced:
+vendor/k8s.io/kubernetes/pkg/scheduler/schedule_one.go:610-694.
 
 Array layout: every per-node tensor becomes one [S, 128] f32 "plane"
 (S = ceil(N/128) sublane rows); planes stack into a single [P, S, 128] VMEM
@@ -90,6 +90,18 @@ class KernelMeta(NamedTuple):
     has_static_pref: bool
 
 
+def _soft_row_domains(ss, c: int) -> int:
+    """Domain count of one soft-constraint row: 0 for hostname rows (sized
+    by the scorable count, no unroll) and for inert padding; else the dense
+    vocabulary size.  Single source for the eligibility cap and the
+    kernel's unroll bound."""
+    if c >= ss.num_constraints or ss.is_hostname[c]:
+        return 0
+    if not (ss.node_domain[c] >= 0).any():
+        return 0
+    return int(ss.node_domain[c].max()) + 1
+
+
 def eligible(cfg: sim.StaticConfig, pb) -> bool:
     """Static check: can this problem run on the fused kernel?"""
     mode = os.environ.get("CC_TPU_FUSED", "auto")
@@ -108,11 +120,8 @@ def eligible(cfg: sim.StaticConfig, pb) -> bool:
         if ss.node_domain.shape[0] > MAX_SPREAD:
             return False
         for c in range(ss.num_constraints):
-            if not ss.is_hostname[c] and (ss.node_domain[c] >= 0).any() \
-                    and int(ss.node_domain[c].max()) + 1 > _SOFT_DOMAIN_CAP:
+            if _soft_row_domains(ss, c) > _SOFT_DOMAIN_CAP:
                 return False
-    if cfg.fit_strategy_type == "RequestedToCapacityRatio":
-        return False
     n = pb.snapshot.num_nodes
     if n == 0 or n > MAX_NODES:
         return False
@@ -168,13 +177,7 @@ def _pack_meta(cfg: sim.StaticConfig, pb, consts) -> _Packing:
     sh = pb.spread_hard
     ss = pb.spread_soft
     cs = ss.node_domain.shape[0]
-    ss_dnh = []
-    for c in range(cs):
-        if c < ss.num_constraints and not ss.is_hostname[c] \
-                and (ss.node_domain[c] >= 0).any():
-            ss_dnh.append(int(ss.node_domain[c].max()) + 1)
-        else:
-            ss_dnh.append(0)
+    ss_dnh = [_soft_row_domains(ss, c) for c in range(cs)]
     meta = KernelMeta(
         n=n, s=s, r=r, cfg=cfg,
         req_vec=tuple(float(x) for x in pb.req_vec),
@@ -527,6 +530,13 @@ def _build_kernel(pk: _Packing, k_steps: int):
                         per = jnp.where(alloc > 0,
                                         _floor_div(jnp.minimum(req, alloc)
                                                    * 100.0, alloc), 0.0)
+                    elif cfg.fit_strategy_type == "RequestedToCapacityRatio":
+                        from ..ops.node_resources_fit import piecewise_shape
+                        util = jnp.where(alloc > 0,
+                                         _floor_div(req * 100.0, alloc), 0.0)
+                        per = jnp.trunc(piecewise_shape(
+                            util, cfg.fit_shape[0], cfg.fit_shape[1]))
+                        per = jnp.where(alloc > 0, per, 0.0)
                     else:
                         per = jnp.where(req > alloc, 0.0,
                                         _floor_div((alloc - req) * 100.0,

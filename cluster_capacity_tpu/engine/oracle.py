@@ -375,11 +375,34 @@ def _fit_score(state: OracleState, i: int, pod: dict,
             r = req.get(name, 0) + podreq_actual.get(name, 0)
         if profile.fit_strategy.type == "MostAllocated":
             rs = min(r, a) * 100 // a
+        elif profile.fit_strategy.type == "RequestedToCapacityRatio":
+            rs = _broken_linear(profile.fit_strategy.shape_utilization,
+                                profile.fit_strategy.shape_score,
+                                r * 100 // a)
         else:
             rs = 0 if r > a else (a - r) * 100 // a
         node_score += rs * weight
         weight_sum += weight
     return node_score // weight_sum if weight_sum else 0
+
+
+def _broken_linear(shape_utilization, shape_score, p: int) -> int:
+    """helper.BuildBrokenLinearFunction (shape_score.go:40-53) in the same
+    pure int64 arithmetic as Go (division truncates toward zero) — an
+    independent expression of the RTC shape, differential target for
+    ops.node_resources_fit.piecewise_shape."""
+    shape = [(int(x), int(y) * 10) for x, y in
+             zip(shape_utilization, shape_score)]
+    for i, (xi, yi) in enumerate(shape):
+        if p <= xi:
+            if i == 0:
+                return shape[0][1]
+            x1, y1 = shape[i - 1]
+            num = (yi - y1) * (p - x1)
+            den = xi - x1
+            q = abs(num) // den if num >= 0 else -(abs(num) // den)
+            return y1 + q
+    return shape[-1][1]
 
 
 def _balanced_score(state: OracleState, i: int, pod: dict,

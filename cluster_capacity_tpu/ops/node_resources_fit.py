@@ -84,6 +84,31 @@ def most_allocated_score(alloc: jnp.ndarray, req_with_pod: jnp.ndarray,
     return jnp.where(wsum > 0, _floor_div(total, wsum), 0.0)
 
 
+def piecewise_shape(util: jnp.ndarray, shape_utilization: Sequence[float],
+                    shape_score: Sequence[float]) -> jnp.ndarray:
+    """helper.BuildBrokenLinearFunction (shape_score.go:40-53), exactly: the
+    reference computes in pure int64 with Go's truncate-toward-zero
+    division —
+
+        y1 + (y2-y1)*(p-x1)/(x2-x1)
+
+    All quantities are small exact integers (scores x10 <= 100, utilization
+    0-100+), so integer products stay exact in float32 and the float
+    quotient truncates to the same value as Go's int64 division (the
+    quotient is always >= 1/(x2-x1) away from the next integer).  Single
+    formula shared by the XLA score path and the fused kernel."""
+    xs = [float(x) for x in shape_utilization]
+    ys = [float(y) * 10.0 for y in shape_score]
+    out = jnp.full_like(util, ys[0])
+    for i in range(1, len(xs)):
+        dx = xs[i] - xs[i - 1]
+        q = (ys[i] - ys[i - 1]) * (util - xs[i - 1]) / (dx if dx else 1.0)
+        seg = ys[i - 1] + jnp.trunc(q)
+        out = jnp.where((util > xs[i - 1]) & (util <= xs[i]), seg, out)
+    out = jnp.where(util > xs[-1], ys[-1], out)
+    return out
+
+
 def requested_to_capacity_ratio_score(alloc: jnp.ndarray,
                                       req_with_pod: jnp.ndarray,
                                       weights: jnp.ndarray,
@@ -91,18 +116,10 @@ def requested_to_capacity_ratio_score(alloc: jnp.ndarray,
                                       shape_score: Sequence[float]) -> jnp.ndarray:
     """requestedToCapacityRatioScorer: per-resource utilization (0-100) mapped
     through the configured piecewise-linear shape (scores 0-10, scaled x10),
-    then the same weighted integer mean.
-
-    Mirrors helper.BuildBrokerFunction semantics: utilization below the first
-    point gets the first score, above the last point the last score; between
-    points linear interpolation truncated toward zero per segment."""
-    xs = jnp.asarray(np.asarray(shape_utilization, dtype=np.float64),
-                     dtype=alloc.dtype)
-    ys = jnp.asarray(np.asarray(shape_score, dtype=np.float64) * 10.0,
-                     dtype=alloc.dtype)
+    then the same weighted integer mean."""
     valid = alloc > 0
     util = jnp.where(valid, _floor_div(req_with_pod * MAX_NODE_SCORE, alloc), 0.0)
-    per_res = jnp.trunc(jnp.interp(util, xs, ys))
+    per_res = jnp.trunc(piecewise_shape(util, shape_utilization, shape_score))
     per_res = jnp.where(valid, per_res, 0.0)
     wsum = jnp.sum(jnp.where(valid, weights[None, :], 0.0), axis=1)
     total = jnp.sum(per_res * weights[None, :], axis=1)

@@ -244,3 +244,28 @@ def test_plugin_args():
     res = cc.run()
     assert res.placed_count > 0
     assert set(res.per_node_counts) == {"gold1"}
+
+
+def test_rtc_shape_matches_go_broker():
+    """piecewise_shape must reproduce helper.BuildBrokenLinearFunction
+    (shape_score.go:40-53) bit-exactly in both dtypes — the Go code runs
+    pure int64 arithmetic with truncate-toward-zero division; the oracle's
+    _broken_linear is the independent int port."""
+    import jax.numpy as jnp
+    import numpy as np
+    from cluster_capacity_tpu.engine.oracle import _broken_linear
+    from cluster_capacity_tpu.ops.node_resources_fit import piecewise_shape
+
+    rng = np.random.RandomState(5)
+    for _ in range(300):
+        npts = rng.randint(2, 5)
+        xs = np.sort(rng.choice(np.arange(0, 101), size=npts,
+                                replace=False)).astype(int)
+        ys = rng.randint(0, 11, size=npts).astype(int)
+        utils = np.arange(0, 131)
+        want = np.asarray([_broken_linear(xs.tolist(), ys.tolist(), int(p))
+                           for p in utils], dtype=float)
+        for dt in (jnp.float64, jnp.float32):
+            got = np.asarray(piecewise_shape(
+                jnp.asarray(utils, dtype=dt), xs, ys))
+            assert np.array_equal(want, got), (xs, ys)

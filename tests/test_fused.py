@@ -341,3 +341,39 @@ def test_system_default_spreading():
     os.environ.pop("CC_TPU_FUSED", None)
     assert r_fused.placements == r_xla.placements
     assert r_fused.fail_message == r_xla.fail_message
+
+
+def test_requested_to_capacity_ratio_strategy():
+    """RTC scoring strategy in both paths, sharing one piecewise helper.
+    Shape prefers ~50% utilization -> medium nodes win over empty big ones."""
+    profile = SchedulerProfile()
+    profile.fit_strategy.type = "RequestedToCapacityRatio"
+    profile.fit_strategy.shape_utilization = [0.0, 50.0, 100.0]
+    profile.fit_strategy.shape_score = [0.0, 10.0, 0.0]
+    pod = {"metadata": {"name": "p"}, "spec": {"containers": [
+        {"name": "c", "resources": {"requests": {"cpu": "400m",
+                                                 "memory": "512Mi"}}}]}}
+    r = _solve_both(_nodes(25), pod, profile=profile)
+    assert r.placed_count > 0
+
+
+def test_rtc_shape_behavior():
+    """Engine-level RTC semantics: a utilization-50-peaked shape places on
+    the half-full node first (requested_to_capacity_ratio.go:60)."""
+    import sys
+    sys.path.insert(0, "tests")
+    from helpers import build_test_node, build_test_pod
+
+    profile = SchedulerProfile.parity()
+    profile.fit_strategy.type = "RequestedToCapacityRatio"
+    profile.fit_strategy.shape_utilization = [0.0, 50.0, 100.0]
+    profile.fit_strategy.shape_score = [0.0, 10.0, 0.0]
+    nodes = [build_test_node("empty", 1000, int(1e12), 50),
+             build_test_node("half", 1000, int(1e12), 50)]
+    existing = [build_test_pod("e0", 400, 0, node_name="half")]
+    snap = ClusterSnapshot.from_objects(nodes, pods=existing)
+    pb = enc.encode_problem(snap, default_pod(build_test_pod("p", 100, -1)),
+                            profile)
+    res = sim.solve(pb, max_limit=1)
+    # empty: util (0+100)/1000 = 10 -> score 2*10=20ish; half: util 50 -> peak
+    assert res.placements == [snap.node_names.index("half")]

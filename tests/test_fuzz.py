@@ -159,6 +159,13 @@ def run_differential(seed, n_nodes=None, pct=None, node_order=None,
     profile = SchedulerProfile.parity()
     if pct is not None:
         profile.percentage_of_nodes_to_score = pct
+    strat = rng.rand()
+    if strat < 0.15:
+        profile.fit_strategy.type = "MostAllocated"
+    elif strat < 0.3:
+        profile.fit_strategy.type = "RequestedToCapacityRatio"
+        profile.fit_strategy.shape_utilization = [0.0, 50.0, 100.0]
+        profile.fit_strategy.shape_score = [0.0, 10.0, 5.0]
     limit = 40
 
     expected, expected_reasons = oracle.simulate(snapshot, pod, profile,
