@@ -225,3 +225,44 @@ def test_golden_missing_extended_resource():
     assert res.placed_count == 0
     assert res.fail_message == \
         "0/3 nodes are available: 3 Insufficient example.com/fpga."
+
+
+def test_golden_preferred_anti_affinity_round_robin():
+    """Manual arithmetic (scoring.go:268-300 min-max normalize + the 2x
+    both-directions dynamic weight), reduced profile with ONLY the
+    InterPodAffinity score active (weight 2).
+
+    3 identical nodes (2 pod slots each); pod has preferred self
+    anti-affinity on hostname, weight 10 (dynamic per-placement weight
+    2x10=20, negative).
+
+      step 1: all raw 0 -> max==min -> all normalize to 0 -> tie -> n0
+      step 2: raw n0=-20, others 0 -> norm: n0=0, n1=n2=floor(100*20/20)
+              =100 -> tie at 100 -> n1
+      step 3: raw n0=n1=-20, n2=0 -> n2=100 wins -> n2
+      step 4: all raw -20 -> max==min -> all 0 -> tie -> n0
+      steps 5-6: repeat the rotation -> n1, n2
+      step 7: every node at its 2-pod slot cap -> STOP:
+              "0/3 nodes are available: 3 Too many pods."
+    Expected: [n0, n1, n2, n0, n1, n2].  (Derivation: manual-arithmetic.)"""
+    profile = SchedulerProfile.parity()
+    profile.score_weights = {"InterPodAffinity": 2}
+    nodes = [build_test_node(f"n{i}", 4000, int(1e12), 2,
+                             labels={"kubernetes.io/hostname": f"n{i}"})
+             for i in range(3)]
+    pod = default_pod({
+        "metadata": {"name": "p", "labels": {"app": "rr"},
+                     "namespace": "default"},
+        "spec": {"containers": [{"name": "c", "resources": {"requests": {
+            "cpu": "100m"}}}],
+            "affinity": {"podAntiAffinity": {
+                "preferredDuringSchedulingIgnoredDuringExecution": [{
+                    "weight": 10, "podAffinityTerm": {
+                        "topologyKey": "kubernetes.io/hostname",
+                        "labelSelector": {
+                            "matchLabels": {"app": "rr"}}}}]}}}})
+    snapshot = ClusterSnapshot.from_objects(nodes)
+    pb = enc.encode_problem(snapshot, pod, profile)
+    res = sim.solve(pb)
+    assert res.placements == [0, 1, 2, 0, 1, 2]
+    assert res.fail_message == "0/3 nodes are available: 3 Too many pods."
