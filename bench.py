@@ -162,6 +162,57 @@ def bench_scan(platform: str, with_spread: bool = False,
     return res.placed_count, dt, fused_used
 
 
+def bench_sweep(platform: str):
+    """BASELINE config 3: many heterogeneous genpod-style templates WITH
+    PodTopologySpread, solved as vmapped group solves against one snapshot."""
+    from cluster_capacity_tpu.models.podspec import default_pod
+    from cluster_capacity_tpu.models.snapshot import ClusterSnapshot
+    from cluster_capacity_tpu.parallel.sweep import sweep
+
+    rng = np.random.RandomState(7)
+    n_nodes = int(os.environ.get("BENCH_SWEEP_NODES", "1000"))
+    n_templates = int(os.environ.get(
+        "BENCH_SWEEP_TEMPLATES", "100" if platform not in ("cpu",) else "20"))
+    limit = int(os.environ.get("BENCH_SWEEP_LIMIT", "100"))
+
+    nodes = []
+    for i in range(n_nodes):
+        nodes.append({
+            "metadata": {"name": f"node-{i:05d}",
+                         "labels": {"kubernetes.io/hostname": f"node-{i:05d}",
+                                    "topology.kubernetes.io/zone": f"zone-{i % 8}"}},
+            "spec": {},
+            "status": {"allocatable": {
+                "cpu": f"{int(rng.choice([16000, 32000]))}m",
+                "memory": str(int(rng.choice([64, 128])) * 1024 ** 3),
+                "pods": "110"}},
+        })
+    snapshot = ClusterSnapshot.from_objects(nodes)
+
+    templates = []
+    for k in range(n_templates):
+        templates.append(default_pod({
+            "metadata": {"name": f"t{k}", "labels": {"app": f"t{k}"}},
+            "spec": {"containers": [{
+                "name": "c", "resources": {"requests": {
+                    "cpu": f"{int(rng.choice([100, 250, 500]))}m",
+                    "memory": str(int(rng.choice([256, 512])) * 1024 ** 2)}}}],
+                "topologySpreadConstraints": [{
+                    "maxSkew": int(rng.choice([4, 8])),
+                    "topologyKey": "topology.kubernetes.io/zone",
+                    "whenUnsatisfiable": "DoNotSchedule",
+                    "labelSelector": {"matchLabels": {"app": f"t{k}"}}}]}}))
+
+    # warmup must use the SAME batch size: the jitted group step specializes
+    # on the stacked consts/carry shapes
+    sweep(snapshot, templates, max_limit=limit)
+    t0 = time.perf_counter()
+    results = sweep(snapshot, templates, max_limit=limit)
+    dt = time.perf_counter() - t0
+    placed = sum(r.placed_count for r in results)
+    return placed, dt, n_templates, n_nodes
+
+
 def main() -> None:
     platform = _ensure_platform()
 
@@ -180,6 +231,12 @@ def main() -> None:
     sys.stderr.write(f"bench: scan+ipa {ipa_placed} placements in "
                      f"{ipa_dt:.3f}s on {platform} (fused={ipa_fused})\n")
 
+    sw_placed, sw_dt, sw_templates, sw_nodes = bench_sweep(platform)
+    sw_pps = sw_placed / sw_dt
+    sys.stderr.write(f"bench: sweep {sw_templates} spread templates x "
+                     f"{sw_nodes} nodes: {sw_placed} placements in "
+                     f"{sw_dt:.3f}s on {platform}\n")
+
     print(json.dumps({
         "metric": f"full_capacity_placements_per_sec_{N_NODES}_nodes",
         "value": round(fp_pps, 2),
@@ -194,6 +251,9 @@ def main() -> None:
         "scan_engine_fused_ipa": bool(ipa_fused),
         "fast_path_seconds_for_full_estimate": round(fp_dt, 3),
         "fast_path_total_placements": fp_placed,
+        "sweep_spread_templates_placements_per_sec": round(sw_pps, 2),
+        "sweep_spread_templates": sw_templates,
+        "sweep_spread_nodes": sw_nodes,
     }))
 
 
