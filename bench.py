@@ -77,18 +77,20 @@ def _ensure_platform() -> str:
     return jax.default_backend()
 
 
-def _make_nodes():
-    rng = np.random.RandomState(0)
+def _make_nodes(n_nodes=None, n_zones=16, cpus=(16000, 32000, 64000),
+                mems=(64, 128, 256), seed=0):
+    rng = np.random.RandomState(seed)
     nodes = []
-    for i in range(N_NODES):
+    for i in range(n_nodes if n_nodes is not None else N_NODES):
         nodes.append({
             "metadata": {"name": f"node-{i:06d}",
                          "labels": {"kubernetes.io/hostname": f"node-{i:06d}",
-                                    "topology.kubernetes.io/zone": f"zone-{i % 16}"}},
+                                    "topology.kubernetes.io/zone":
+                                        f"zone-{i % n_zones}"}},
             "spec": {},
             "status": {"allocatable": {
-                "cpu": f"{int(rng.choice([16000, 32000, 64000]))}m",
-                "memory": str(int(rng.choice([64, 128, 256])) * 1024 ** 3),
+                "cpu": f"{int(rng.choice(list(cpus)))}m",
+                "memory": str(int(rng.choice(list(mems))) * 1024 ** 3),
                 "pods": "110"}},
         })
     return nodes
@@ -175,19 +177,9 @@ def bench_sweep(platform: str):
         "BENCH_SWEEP_TEMPLATES", "100" if platform not in ("cpu",) else "20"))
     limit = int(os.environ.get("BENCH_SWEEP_LIMIT", "100"))
 
-    nodes = []
-    for i in range(n_nodes):
-        nodes.append({
-            "metadata": {"name": f"node-{i:05d}",
-                         "labels": {"kubernetes.io/hostname": f"node-{i:05d}",
-                                    "topology.kubernetes.io/zone": f"zone-{i % 8}"}},
-            "spec": {},
-            "status": {"allocatable": {
-                "cpu": f"{int(rng.choice([16000, 32000]))}m",
-                "memory": str(int(rng.choice([64, 128])) * 1024 ** 3),
-                "pods": "110"}},
-        })
-    snapshot = ClusterSnapshot.from_objects(nodes)
+    snapshot = ClusterSnapshot.from_objects(_make_nodes(
+        n_nodes=n_nodes, n_zones=8, cpus=(16000, 32000), mems=(64, 128),
+        seed=7))
 
     templates = []
     for k in range(n_templates):

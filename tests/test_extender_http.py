@@ -1,0 +1,80 @@
+"""HTTP extender protocol over a real local server: filter/prioritize/bind/
+preempt verbs with the kube-scheduler extender/v1 payload shapes
+(vendor/k8s.io/kube-scheduler/extender/v1/types.go)."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from cluster_capacity_tpu.engine import encode as enc
+from cluster_capacity_tpu.engine.extenders import (ExtenderConfig,
+                                                   solve_with_extenders)
+from cluster_capacity_tpu.models.podspec import default_pod
+from cluster_capacity_tpu.models.snapshot import ClusterSnapshot
+from cluster_capacity_tpu.utils.config import SchedulerProfile
+
+from helpers import build_test_node, build_test_pod
+
+
+class _Handler(BaseHTTPRequestHandler):
+    calls = []
+
+    def do_POST(self):
+        body = json.loads(self.rfile.read(
+            int(self.headers["Content-Length"])).decode())
+        verb = self.path.rsplit("/", 1)[-1]
+        _Handler.calls.append((verb, body))
+        if verb == "filter":
+            # drop n0; cache-capable protocol returns NodeNames
+            names = [n for n in body.get("NodeNames") or [] if n != "n0"]
+            out = {"NodeNames": names}
+        elif verb == "prioritize":
+            out = [{"Host": n, "Score": 7 if n == "n2" else 0}
+                   for n in body.get("NodeNames") or []]
+        elif verb == "bind":
+            out = {}                     # success
+        else:
+            out = {"Error": f"unknown verb {verb}"}
+        payload = json.dumps(out).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def log_message(self, *a):            # silence
+        pass
+
+
+@pytest.fixture()
+def http_extender():
+    _Handler.calls = []
+    srv = HTTPServer(("127.0.0.1", 0), _Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_port}/scheduler"
+    srv.shutdown()
+    srv.server_close()
+
+
+def test_http_filter_prioritize_bind(http_extender):
+    nodes = [build_test_node(f"n{i}", 1000, 4 * 1024 ** 3, 5)
+             for i in range(3)]
+    snap = ClusterSnapshot.from_objects(nodes)
+    pod = default_pod(build_test_pod("p", 300, 0))
+    pb = enc.encode_problem(snap, pod, SchedulerProfile.parity())
+
+    ext = ExtenderConfig(url_prefix=http_extender, filter_verb="filter",
+                         prioritize_verb="prioritize", bind_verb="bind",
+                         weight=100, node_cache_capable=True)
+    res = solve_with_extenders(pb, [ext], max_limit=2)
+    assert res.placed_count == 2
+    # extender filter removed n0; weighted prioritize (100 * 7) favors n2
+    assert [res.node_names[i] for i in res.placements] == ["n2", "n2"]
+    verbs = [v for v, _ in _Handler.calls]
+    assert verbs.count("filter") >= 2 and verbs.count("bind") == 2
+    bind_bodies = [b for v, b in _Handler.calls if v == "bind"]
+    assert bind_bodies[0]["Node"] == "n2"
+    assert bind_bodies[0]["PodName"] == "p"

@@ -1,0 +1,89 @@
+"""sync_with_client against duck-typed fake API objects: full resource-kind
+coverage (simulator.go:176-295 parity), multi-API fallback, and graceful
+RBAC degradation."""
+
+import pytest
+
+from cluster_capacity_tpu import ClusterCapacity, SchedulerProfile
+from cluster_capacity_tpu.models.podspec import default_pod
+
+
+class _Items:
+    def __init__(self, items):
+        self.items = items
+
+
+def _node(name, cpu="2"):
+    return {"metadata": {"name": name}, "spec": {},
+            "status": {"allocatable": {"cpu": cpu, "memory": "4Gi",
+                                       "pods": "10"}}}
+
+
+class FakeCore:
+    """CoreV1-ish facade: nodes, pods, and a few core kinds."""
+
+    def list_node(self):
+        return _Items([_node("n0"), _node("n1")])
+
+    def list_pod_for_all_namespaces(self):
+        return _Items([{"metadata": {"name": "e0", "namespace": "default"},
+                        "spec": {"nodeName": "n0", "containers": [
+                            {"name": "c", "resources": {
+                                "requests": {"cpu": "500m"}}}]},
+                        "status": {"phase": "Running"}}])
+
+    def list_namespace(self):
+        return _Items([{"metadata": {"name": "default"}}])
+
+    def list_service_for_all_namespaces(self):
+        return _Items([{"metadata": {"name": "svc", "namespace": "default"},
+                        "spec": {"selector": {"app": "x"}}}])
+
+    def list_pod_disruption_budget_for_all_namespaces(self):
+        raise RuntimeError("403 forbidden")       # RBAC-denied on core
+
+
+class FakePolicy:
+    """The properly-authorized PolicyV1 facade passed as an extra api."""
+
+    def list_pod_disruption_budget_for_all_namespaces(self):
+        return _Items([{"metadata": {"name": "pdb", "namespace": "default"},
+                        "spec": {"selector": {"matchLabels": {"app": "x"}}},
+                        "status": {"disruptionsAllowed": 1}}])
+
+
+def test_sync_with_client_all_kinds_and_fallback():
+    pod = {"metadata": {"name": "p"}, "spec": {"containers": [
+        {"name": "c", "resources": {"requests": {"cpu": "500m"}}}]}}
+    cc = ClusterCapacity(default_pod(pod), profile=SchedulerProfile.parity())
+    cc.sync_with_client(FakeCore(), FakePolicy())
+
+    snap = cc.snapshot
+    assert snap.num_nodes == 2
+    assert sum(len(p) for p in snap.pods_by_node) == 1
+    assert snap.namespaces and snap.services
+    # the denied core PDB call fell through to the authorized policy api
+    assert snap.pdbs and snap.pdbs[0]["metadata"]["name"] == "pdb"
+
+    res = cc.run()
+    # n0 has 500m used -> 3 fit on n0, 4 on n1
+    assert res.placed_count == 7
+
+
+def test_sync_with_client_degrades_with_warning(capsys):
+    class DeniedEverything(FakeCore):
+        def list_namespace(self):
+            raise RuntimeError("403")
+
+        def list_service_for_all_namespaces(self):
+            raise RuntimeError("403")
+
+    pod = {"metadata": {"name": "p"}, "spec": {"containers": [
+        {"name": "c", "resources": {"requests": {"cpu": "100m"}}}]}}
+    cc = ClusterCapacity(default_pod(pod), profile=SchedulerProfile.parity())
+    cc.sync_with_client(DeniedEverything())
+    err = capsys.readouterr().err
+    assert "skipping namespaces sync" in err
+    assert "skipping services sync" in err
+    assert cc.snapshot.num_nodes == 2        # nodes+pods still analyzed
+    assert cc.run().placed_count > 0
