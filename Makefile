@@ -7,7 +7,7 @@ CXX ?= g++
 CXXFLAGS ?= -O2 -std=c++17 -fPIC -Wall
 NATIVE_LIB := cluster_capacity_tpu/models/libccsnap.so
 
-.PHONY: all build native test-unit test-parity test-integration test-e2e bench clean verify-native
+.PHONY: all build native test-unit test-parity test-fuzz test-dist test-integration test-e2e bench clean verify-native
 
 all: build
 
@@ -25,6 +25,14 @@ test-unit:
 # Differential parity sweep vs the sequential CPU oracle.
 test-parity:
 	$(PY) -m pytest tests/test_oracle_parity.py tests/test_fast_path.py -q
+
+# Full differential fuzz: 200 mixed-family seeds + 60 fused-kernel seeds.
+test-fuzz:
+	$(PY) -m pytest tests/test_fuzz.py tests/test_fused.py -m fuzz -q
+
+# Multi-host DCN proof: 2 CPU processes over one 8-device mesh.
+test-dist:
+	$(PY) -m pytest tests/test_distributed.py -m dist -q
 
 # Integration smoke: drive the CLI end-to-end against the example snapshot
 # (the analog of test/integration-tests.sh's live-cluster grep).
