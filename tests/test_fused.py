@@ -377,3 +377,39 @@ def test_rtc_shape_behavior():
     res = sim.solve(pb, max_limit=1)
     # empty: util (0+100)/1000 = 10 -> score 2*10=20ish; half: util 50 -> peak
     assert res.placements == [snap.node_names.index("half")]
+
+
+def test_pack_unpack_roundtrip():
+    """FusedRunner.pack/unpack must preserve the carry exactly — a plane
+    ordering or padding bug here would corrupt every chunk boundary."""
+    import jax
+
+    pod = {"metadata": {"name": "p", "labels": {"app": "rt"}}, "spec": {
+        "containers": [{"name": "c", "resources": {
+            "requests": {"cpu": "300m", "memory": "512Mi"}}}],
+        "topologySpreadConstraints": [{
+            "maxSkew": 1, "topologyKey": "topology.kubernetes.io/zone",
+            "whenUnsatisfiable": "DoNotSchedule",
+            "labelSelector": {"matchLabels": {"app": "rt"}}}],
+        "affinity": {"podAntiAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": [{
+                "topologyKey": "kubernetes.io/hostname",
+                "labelSelector": {"matchLabels": {"app": "rt"}}}]}}}}
+    snap = ClusterSnapshot.from_objects(_nodes(30, zones=3))
+    pb = enc.encode_problem(snap, default_pod(pod), SchedulerProfile())
+    cfg = sim.static_config(pb)
+    consts = sim.build_consts(pb)
+    carry = sim._init_carry(pb, consts, 0)
+    # advance a few steps so the carry is non-trivial
+    run = sim._chunk_runner()
+    carry, _ = run(cfg, consts, carry, 5)
+
+    runner = fused.FusedRunner(cfg, pb, consts, interpret=True)
+    state = runner.pack(carry)
+    back = runner.unpack(state, carry)
+    for name in ("requested", "nonzero", "placed", "sh_cnt", "aff_cnt",
+                 "anti_cnt", "placed_count", "stopped", "next_start",
+                 "aff_total"):
+        a = np.asarray(getattr(carry, name))
+        b = np.asarray(getattr(back, name))
+        assert np.array_equal(a, b), name
