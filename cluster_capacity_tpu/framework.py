@@ -204,7 +204,8 @@ class ClusterCapacity:
             # full rebuild is the fallback when vocab/shared-claim rules
             # prevent it.
             victim_ids = {id(v) for v in outcome.victims}
-            victim_keys = {_pod_key(v) for v in outcome.victims}
+            victim_keys = {k for v in outcome.victims
+                           if (k := _pod_key(v)) is not None}
             new_pbn = [[p for p in plist if id(p) not in victim_ids
                         and _pod_key(p) not in victim_keys]
                        for plist in snap.pods_by_node]
@@ -267,10 +268,17 @@ class ClusterCapacity:
         self._result = None
 
 
-def _pod_key(pod: dict) -> tuple:
+def _pod_key(pod: dict):
+    """Identity key for victim matching; None when the pod has neither a
+    name nor a uid — a metadata-less key would match every other
+    metadata-less pod and evict them all, so such pods only ever match
+    by object identity (id())."""
     meta = pod.get("metadata") or {}
-    return (meta.get("namespace") or "default", meta.get("name", ""),
-            meta.get("uid", ""))
+    name = meta.get("name", "")
+    uid = meta.get("uid", "")
+    if not name and not uid:
+        return None
+    return (meta.get("namespace") or "default", name, uid)
 
 
 def _to_dict(obj):

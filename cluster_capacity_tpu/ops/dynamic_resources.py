@@ -17,8 +17,10 @@ TPU-native reduction implemented here:
   structured allocator), shared counters bound partition co-allocation, and
   the answer folds into one per-node virtual column `dra/__slots__`
   (allocatable = max clones the node's free devices support, request = 1 per
-  clone) — exact for identical clones because device state never changes
-  mid-solve.
+  clone).  Device state never changes mid-solve, so the column is exact for
+  identical clones on counter-free nodes; with shared-counter pools the
+  greedy first-fit count is a LOWER BOUND on the reference's backtracking
+  structured allocator (it never over-admits).
 - SHARED named ResourceClaims are allocated ONCE: their devices are charged
   on the first placement only, every user colocates with the allocation, and
   a claim that is already allocated (status.allocation) pins all users to
@@ -81,6 +83,48 @@ class DraEncoding:
 # boolean operators, and evaluates against a small device view object.
 # ---------------------------------------------------------------------------
 
+class _SafeStr(str):
+    """Device-sourced strings as seen by eval(): comparisons and `in`
+    work, but repetition/concatenation raise — `device.driver * 10**9`
+    must not allocate gigabytes (the static allowlist only sees literal
+    operands; this closes the Attribute/Subscript route)."""
+
+    def _refuse(self, *_a):
+        raise TypeError("string arithmetic outside the CEL subset")
+
+    __mul__ = __rmul__ = __add__ = __radd__ = __mod__ = _refuse
+
+    def __getitem__(self, i):
+        # CEL has no string index operator — the reference's CEL runtime
+        # errors and marks the device non-matching, so raising here (and
+        # not handing back a plain, arithmetic-capable str) is both the
+        # parity behavior and the DoS guard
+        raise TypeError("string indexing outside the CEL subset")
+
+
+_CEL_INT_MIN, _CEL_INT_MAX = -2 ** 63, 2 ** 63 - 1
+
+
+def _safe_value(v):
+    if isinstance(v, str):
+        return _SafeStr(v)
+    if isinstance(v, bool) or v is None:
+        return v
+    if isinstance(v, int):
+        if not _CEL_INT_MIN <= v <= _CEL_INT_MAX:
+            # CEL ints are int64; a cluster-sourced bignum outside the
+            # range is a CEL error (→ non-match), and refusing it also
+            # stops arithmetic amplification over unbounded Python ints
+            raise OverflowError("attribute outside CEL int64 range")
+        return v
+    if isinstance(v, float):
+        return v
+    # CEL attribute values are string/int/bool/version only — anything
+    # else a hostile slice smuggles in (e.g. a LIST, which would make
+    # `attr * 10**9` allocate gigabytes) is a CEL type error → non-match
+    raise TypeError(f"attribute type outside the CEL subset: {type(v)!r}")
+
+
 class _AttrView:
     """Attribute access over one qualified-name namespace."""
 
@@ -92,10 +136,10 @@ class _AttrView:
             raise AttributeError(name)
         if name not in self._values:
             raise KeyError(name)
-        return self._values[name]
+        return _safe_value(self._values[name])
 
     def __getitem__(self, name):
-        return self._values[name]
+        return _safe_value(self._values[name])
 
 
 class _QualifiedMap:
@@ -117,7 +161,7 @@ class DeviceView:
     def __init__(self, device: "Device"):
         self.attributes = _QualifiedMap(device.attributes)
         self.capacity = _QualifiedMap(device.capacity)
-        self.driver = device.driver
+        self.driver = _SafeStr(device.driver)
 
 
 _WORD_RE = re.compile(r"[A-Za-z_][A-Za-z_0-9]*")
@@ -162,17 +206,59 @@ _ALLOWED_CEL_NODES = (
     "Expression", "BoolOp", "And", "Or", "UnaryOp", "Not", "USub",
     "Compare", "Eq", "NotEq", "Lt", "LtE", "Gt", "GtE", "In", "NotIn",
     "Attribute", "Subscript", "Name", "Load", "Constant",
-    "BinOp", "Add", "Sub", "Mult", "Div", "Mod",
+    # no Div/Mod: CEL truncates toward zero while Python true-divides /
+    # floors — a silently-different answer is worse than "outside the
+    # subset" (which means 'no match', same as a CEL runtime error in
+    # allocator.go)
+    "BinOp", "Add", "Sub", "Mult",
     "List", "Tuple",                 # literal containers for `in [...]`
 )
+
+_CEL_MAX_EXPR_LEN = 4096
+
+
+def _rooted_at_device(node) -> bool:
+    """True iff an Attribute/Subscript chain bottoms out at the `device`
+    Name — i.e. the value came through DeviceView, whose _SafeStr wrapping
+    refuses string arithmetic at runtime."""
+    import ast
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return isinstance(node, ast.Name) and node.id == "device"
+
+
+def _arith_operand_safe(node) -> bool:
+    """Positive allowlist for BinOp operands: a hostile selector must not
+    get a str/list into `*`/`+` (`[0] * 10**9`, `("a" or "b") * 10**9`,
+    `["a"][0] * 10**9` all allocate unbounded memory inside eval()).
+    Allowed: numeric literals, nested arithmetic (operands checked by the
+    walk), unary minus over those, and device-rooted lookups (strings
+    there are _SafeStr and refuse arithmetic at runtime)."""
+    import ast
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, (int, float, complex, bool))
+    if isinstance(node, ast.BinOp):
+        return True
+    if isinstance(node, ast.UnaryOp):
+        return _arith_operand_safe(node.operand)
+    if isinstance(node, (ast.Attribute, ast.Subscript)):
+        return _rooted_at_device(node)
+    return False
 
 
 def _cel_expr_safe(py_expr: str) -> bool:
     """Static AST allowlist: selectors come from CLUSTER objects (a live
     sync pulls anyone's ResourceClaimTemplates), so eval() must only ever
     see comparisons over the `device` view — no calls, no dunders, no
-    other names."""
+    other names, no lookups rooted anywhere but `device`, and arithmetic
+    only over numbers or device-rooted values (see _arith_operand_safe)."""
     import ast
+    # the raw selector is capped at _CEL_MAX_EXPR_LEN before the rewrite
+    # (cel_matches); the rewrite expands operators at most 5x ('!' →
+    # ' not '), so this bound is purely defensive and must NOT bite
+    # legitimate selectors under the raw cap
+    if len(py_expr) > 5 * _CEL_MAX_EXPR_LEN + 16:
+        return False
     try:
         tree = ast.parse(py_expr, mode="eval")
     except SyntaxError:
@@ -187,6 +273,13 @@ def _cel_expr_safe(py_expr: str) -> bool:
         if isinstance(node, ast.Constant) and isinstance(node.value, str) \
                 and "__" in node.value:
             return False
+        if isinstance(node, (ast.Attribute, ast.Subscript)) \
+                and not _rooted_at_device(node):
+            return False
+        if isinstance(node, ast.BinOp) and not (
+                _arith_operand_safe(node.left)
+                and _arith_operand_safe(node.right)):
+            return False
     return True
 
 
@@ -195,6 +288,9 @@ def cel_matches(expr: str, device: "Device") -> bool:
     evaluation errors, and expressions outside the supported subset mean
     'does not match' (the reference treats runtime CEL errors as a
     non-matching device with an event, allocator.go)."""
+    if len(expr) > _CEL_MAX_EXPR_LEN:
+        return False          # refuse oversized selectors before the
+                              # O(n) token rewrite even runs
     py_expr = _cel_to_python(expr)
     if not _cel_expr_safe(py_expr):
         return False
@@ -422,15 +518,30 @@ def compute_slot_columns(snapshot, reqs: List[SlotRequest]
         if not units:
             slots[i] = _SLOTS_UNLIMITED
             continue
-        # greedy feasibility is monotone in k (smaller k allocates a subset
-        # of the same sorted unit list) → binary search, not linear probing
-        lo, hi = 0, len(free) // max(1, len(units))
+        cap = len(free) // max(1, len(units))
+        # binary search first: its answer f satisfies fits(f), so it is a
+        # sound floor even when greedy feasibility is non-monotone
+        lo, hi = 0, cap
         while lo < hi:
             mid = (lo + hi + 1) // 2
             if _fits_k_clones(mid, units, len(free), consumes, pools):
                 lo = mid
             else:
                 hi = mid - 1
+        if pools or any(consumes):
+            # with shared counter pools greedy first-fit is NOT provably
+            # monotone in k, so the search may have discarded a feasible
+            # upper region — rescue with O(log cap) probes stepping down
+            # from the cap (densest near cap, where a rescue matters).
+            # Any feasible k is sound: the answer is a greedy lower bound
+            # on the reference's backtracking allocator either way.
+            step, k = 1, cap
+            while k > lo:
+                if _fits_k_clones(k, units, len(free), consumes, pools):
+                    lo = k
+                    break
+                k -= step
+                step *= 2
         slots[i] = float(lo)
     return slots
 

@@ -317,3 +317,144 @@ def test_allocation_mode_all_requires_a_device():
                    resource_claim_templates=[tmpl])
     assert res.placed_count == 0
     assert "cannot allocate all claims" in res.fail_message
+
+
+# --- CEL sandbox hardening (advisor r2) ------------------------------------
+
+def _mem_device(mem):
+    from cluster_capacity_tpu.ops.dynamic_resources import Device
+    return Device(name="d", device_class="gpu.example.com",
+                  driver="gpu.example.com",
+                  capacity={"gpu.example.com": {"memory": mem}})
+
+
+def test_cel_literal_arithmetic_rejected():
+    """A hostile selector multiplying/adding list or str literals must be
+    refused statically, never eval'd ('[0] * 10**9' would allocate GBs)."""
+    from cluster_capacity_tpu.ops.dynamic_resources import cel_matches
+    dev = _mem_device(4)
+    assert cel_matches("[0] * 1000000000 == []", dev) is False
+    assert cel_matches("[0, 1] + [2] == [0, 1, 2]", dev) is False
+    assert cel_matches('"a" * 1000000000 == ""', dev) is False
+    # nested: the literal hides one BinOp down
+    assert cel_matches("([0] * 2) * 1000000000 == []", dev) is False
+    # device-SOURCED strings dodge the static literal check (Attribute/
+    # Subscript operands) — the runtime _SafeStr guard must refuse them
+    assert cel_matches('device.driver * 1000000000 != ""', dev) is False
+    assert cel_matches('device.driver[0] * 1000000000 != ""', dev) is False
+    # subscripted/bool-op literal containers must not smuggle plain strs
+    # or lists into arithmetic either
+    assert cel_matches('["a"][0] * 1000000000 != ""', dev) is False
+    assert cel_matches('[[0]][0] * 1000000000 != []', dev) is False
+    assert cel_matches('("a" or "b") * 1000000000 != ""', dev) is False
+    dev2 = _mem_device(4)
+    dev2.attributes = {"gpu.example.com": {"model": "a100"}}
+    assert cel_matches(
+        'device.attributes["gpu.example.com"].model * 1000000000 != ""',
+        dev2) is False
+    # ...while comparisons and `in` over the same strings still work
+    assert cel_matches(
+        'device.attributes["gpu.example.com"].model == "a100"', dev2) is True
+    assert cel_matches(
+        'device.attributes["gpu.example.com"].model in ["a100", "h100"]',
+        dev2) is True
+
+
+def test_cel_numeric_arithmetic_still_works():
+    from cluster_capacity_tpu.ops.dynamic_resources import cel_matches
+    dev = _mem_device(4)
+    assert cel_matches(
+        'device.capacity["gpu.example.com"].memory + 1 >= 5', dev) is True
+    assert cel_matches(
+        'device.capacity["gpu.example.com"].memory * 2 == 8', dev) is True
+
+
+def test_cel_division_outside_subset():
+    """CEL / and % truncate toward zero, Python's floor — the subset
+    refuses both rather than silently diverging (parity-notes.md)."""
+    from cluster_capacity_tpu.ops.dynamic_resources import cel_matches
+    dev = _mem_device(4)
+    assert cel_matches(
+        'device.capacity["gpu.example.com"].memory / 2 >= 1', dev) is False
+    # Python (-4) % 3 == 2 but CEL -4 % 3 == -1: refusing beats over-matching
+    assert cel_matches(
+        'device.capacity["gpu.example.com"].memory % 3 == 1', dev) is False
+
+
+def test_cel_string_indexing_non_matching():
+    """CEL has no string index operator; the reference's CEL runtime
+    errors and the device is non-matching."""
+    from cluster_capacity_tpu.ops.dynamic_resources import cel_matches
+    dev = _mem_device(4)
+    assert cel_matches('device.driver[0] == "g"', dev) is False
+
+
+def test_cel_bignum_attribute_non_matching():
+    """Cluster-sourced ints outside CEL's int64 range are a CEL error
+    (non-match) — and refusing them stops bignum arithmetic
+    amplification."""
+    from cluster_capacity_tpu.ops.dynamic_resources import cel_matches
+    dev = _mem_device(10 ** 100)
+    assert cel_matches(
+        'device.capacity["gpu.example.com"].memory >= 1', dev) is False
+    ok = _mem_device(2 ** 62)
+    assert cel_matches(
+        'device.capacity["gpu.example.com"].memory >= 1', ok) is True
+
+
+def test_cel_list_attribute_non_matching():
+    """A hostile slice smuggling a LIST-typed attribute value must not
+    reach arithmetic ('attr * 10**9' would allocate gigabytes); CEL has
+    no list attribute type, so it is a type error → non-match."""
+    from cluster_capacity_tpu.ops.dynamic_resources import cel_matches
+    dev = _mem_device(4)
+    dev.attributes = {"gpu.example.com": {"l": ["a", "b"]}}
+    assert cel_matches(
+        'device.attributes["gpu.example.com"].l * 1000000000 == []',
+        dev) is False
+    assert cel_matches(
+        'device.attributes["gpu.example.com"].l == ["a", "b"]', dev) is False
+
+
+def test_cel_expression_length_capped():
+    from cluster_capacity_tpu.ops.dynamic_resources import cel_matches
+    dev = _mem_device(4)
+    assert cel_matches("1 == 1" + " && 1 == 1" * 2000, dev) is False
+
+
+def test_counter_pool_count_matches_linear_probe():
+    """With shared counters, the slot count must be a feasible greedy
+    count (the production rescue probes exponentially, so in general it
+    is >= the binary-search floor and <= the best linear-scan k; for
+    this fixture all three coincide at 2)."""
+    from cluster_capacity_tpu.ops.dynamic_resources import _fits_k_clones
+    nodes = [build_test_node("n1", 100000, int(1e11), 500)]
+    # heterogeneous partitions: big ones starve the pool for later clones
+    devices = [
+        {"name": f"p{i}",
+         "consumesCounters": [{"counterSet": "gpu0",
+                               "counters": {"memory": {"value": v}}}]}
+        for i, v in enumerate(["30Gi", "10Gi", "10Gi", "10Gi", "10Gi"])
+    ]
+    counters = [{"name": "gpu0", "counters": {"memory": {"value": "40Gi"}}}]
+    tmpl = _sel_template("part", count=1)
+    res = _run_dra(_pod_with_template_claim("p", "part"), nodes,
+                   resource_slices=[_attr_slice("n1", devices,
+                                                counters=counters)],
+                   resource_claim_templates=[tmpl])
+    gi = 1024 ** 3
+    consumes = [{("gpu0", "memory"): 30 * gi}] + \
+        [{("gpu0", "memory"): 10 * gi}] * 4
+    pools = {("gpu0", "memory"): 40 * gi}
+    units = [[0, 1, 2, 3, 4]]
+    best = 0
+    for k in range(5, 0, -1):
+        if _fits_k_clones(k, units, 5, consumes, pools):
+            best = k
+            break
+    # greedy first-fit grabs the 30Gi partition first, so its best is 2 —
+    # a lower bound on the backtracking answer (4 x 10Gi).  The slot
+    # column must agree with the direct downward scan, not a
+    # binary-search artifact.
+    assert best == 2
+    assert res.placed_count == best

@@ -119,3 +119,15 @@ def test_preemption_cascade_capacity():
     # first round: 100m free → 0 fit? 1000-900=100 < 250 → preempt squatter
     # → 1000 free → 4 x 250m
     assert res.placed_count == 4
+
+
+def test_pod_key_metadata_less_pods_never_cross_match():
+    """Regression (advisor r2): a victim with neither name nor uid must
+    only match by object identity — a ('default','','') key would evict
+    every other metadata-less pod on every node."""
+    from cluster_capacity_tpu.framework import _pod_key
+    assert _pod_key({}) is None
+    assert _pod_key({"metadata": {}}) is None
+    assert _pod_key({"metadata": {"namespace": "ns"}}) is None
+    assert _pod_key({"metadata": {"name": "a"}}) == ("default", "a", "")
+    assert _pod_key({"metadata": {"uid": "u1"}}) == ("default", "", "u1")
