@@ -10,9 +10,12 @@ Two scenarios, both at BENCH_NODES (default 10,000) heterogeneous nodes:
    engine (the path the reference's schedule_one.go:610-694 hot loop maps
    to), running the fused Pallas kernel on TPU and the XLA scan elsewhere.
 
-Prints ONE json line: the headline metric is the fast-path full-capacity
-number (continuity with round 1); the scan-engine spread metric, the JAX
-platform actually used, and per-scenario details ride along as extra keys.
+Prints ONE json line: the headline metric is the SCAN-ENGINE spread number —
+the general carried-state engine on the hard config, the path that maps to
+the reference's schedule_one hot loop — not the analytic fast path (which
+only covers the sorted-prefix special case and rides along as a secondary
+key).  The sweep aggregate, the JAX platform actually used, and per-scenario
+details are extra keys.
 
 vs_baseline: the reference publishes no benchmark numbers (BASELINE.md); the
 comparison point is the commonly-cited kube-scheduler steady-state throughput
@@ -168,7 +171,9 @@ def bench_scan(platform: str, with_spread: bool = False,
 
 def bench_sweep(platform: str):
     """BASELINE config 3: many heterogeneous genpod-style templates WITH
-    PodTopologySpread, solved as vmapped group solves against one snapshot."""
+    PodTopologySpread, solved as group solves against one snapshot — through
+    the batched fused kernel on TPU, the vmapped XLA scan elsewhere."""
+    from cluster_capacity_tpu.engine import fused
     from cluster_capacity_tpu.models.podspec import default_pod
     from cluster_capacity_tpu.models.snapshot import ClusterSnapshot
     from cluster_capacity_tpu.parallel.sweep import sweep
@@ -200,11 +205,13 @@ def bench_sweep(platform: str):
     # warmup must use the SAME batch size: the jitted group step specializes
     # on the stacked consts/carry shapes
     sweep(snapshot, templates, max_limit=limit)
+    bchunks_before = fused.STATS.get("batched_chunks", 0)
     t0 = time.perf_counter()
     results = sweep(snapshot, templates, max_limit=limit)
     dt = time.perf_counter() - t0
     placed = sum(r.placed_count for r in results)
-    return placed, dt, n_templates, n_nodes
+    batched_fused = fused.STATS.get("batched_chunks", 0) > bchunks_before
+    return placed, dt, n_templates, n_nodes, batched_fused
 
 
 def main() -> None:
@@ -225,29 +232,33 @@ def main() -> None:
     sys.stderr.write(f"bench: scan+ipa {ipa_placed} placements in "
                      f"{ipa_dt:.3f}s on {platform} (fused={ipa_fused})\n")
 
-    sw_placed, sw_dt, sw_templates, sw_nodes = bench_sweep(platform)
+    sw_placed, sw_dt, sw_templates, sw_nodes, sw_fused = bench_sweep(platform)
     sw_pps = sw_placed / sw_dt
     sys.stderr.write(f"bench: sweep {sw_templates} spread templates x "
                      f"{sw_nodes} nodes: {sw_placed} placements in "
-                     f"{sw_dt:.3f}s on {platform}\n")
+                     f"{sw_dt:.3f}s on {platform} (batched_fused={sw_fused})\n")
 
+    # Headline = the general engine on the hard config (spread active), the
+    # path mapping to the reference's schedule_one hot loop — NOT the
+    # analytic fast path, which only covers the sorted-prefix special case
+    # and rides along as a secondary key (VERDICT r2 weak #1).
     print(json.dumps({
-        "metric": f"full_capacity_placements_per_sec_{N_NODES}_nodes",
-        "value": round(fp_pps, 2),
+        "metric": f"scan_engine_spread_placements_per_sec_{N_NODES}_nodes",
+        "value": round(sc_pps, 2),
         "unit": "placements/s",
-        "vs_baseline": round(fp_pps / BASELINE_PLACEMENTS_PER_SEC, 2),
+        "vs_baseline": round(sc_pps / BASELINE_PLACEMENTS_PER_SEC, 2),
         "platform": platform,
-        "scan_engine_spread_placements_per_sec": round(sc_pps, 2),
-        "scan_engine_spread_vs_baseline": round(
-            sc_pps / BASELINE_PLACEMENTS_PER_SEC, 2),
-        "scan_engine_ipa_placements_per_sec": round(ipa_pps, 2),
         "scan_engine_fused_kernel": bool(fused_used),
+        "scan_engine_ipa_placements_per_sec": round(ipa_pps, 2),
         "scan_engine_fused_ipa": bool(ipa_fused),
+        "fast_path_placements_per_sec": round(fp_pps, 2),
+        "fast_path_vs_baseline": round(fp_pps / BASELINE_PLACEMENTS_PER_SEC, 2),
         "fast_path_seconds_for_full_estimate": round(fp_dt, 3),
         "fast_path_total_placements": fp_placed,
         "sweep_spread_templates_placements_per_sec": round(sw_pps, 2),
         "sweep_spread_templates": sw_templates,
         "sweep_spread_nodes": sw_nodes,
+        "sweep_batched_fused_kernel": bool(sw_fused),
     }))
 
 

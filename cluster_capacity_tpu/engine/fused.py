@@ -54,6 +54,42 @@ MAX_GROUPS = 4
 # Soft constraints unroll the distinct-domain count over D values — cap it.
 _SOFT_DOMAIN_CAP = 32
 
+# VMEM plane budget: refuse shapes whose working set cannot fit a core's
+# VMEM instead of discovering the Mosaic allocation failure at runtime (a
+# silent perf cliff exactly at headline scale).  16 MiB is the common
+# per-core VMEM; CC_TPU_VMEM_BYTES overrides for other parts.
+VMEM_BYTES = int(os.environ.get("CC_TPU_VMEM_BYTES", 16 * 1024 * 1024))
+_VMEM_BUDGET_FRAC = 0.75
+# Headroom planes for Mosaic temporaries (masks, scores, reductions live
+# alongside the const/carry stacks while a step executes).
+_TEMP_PLANES = 16
+
+
+_vmem_refused: set = set()
+
+
+def vmem_ok(pk: "_Packing", pipelined: bool = False) -> bool:
+    """Does this packing's working set fit the VMEM budget?  Carry counts
+    twice (in + out stacks); pipelined grids double-buffer BOTH the input
+    slabs (prefetch of the next grid step) and the output carry block
+    (writeback of the previous one).  Refusals log once per shape —
+    silent fallbacks hide perf cliffs."""
+    n_const = len(pk.const_names)
+    n_carry = len(pk.carry_names)
+    planes = n_const + 2 * n_carry + _TEMP_PLANES
+    if pipelined:
+        planes += n_const + 2 * n_carry
+    ok = planes * pk.meta.s * LANES * 4 <= _VMEM_BUDGET_FRAC * VMEM_BYTES
+    if not ok:
+        key = (pk.const_names, pk.carry_names, pk.meta.s, pipelined)
+        if key not in _vmem_refused:
+            _vmem_refused.add(key)
+            import sys
+            sys.stderr.write(
+                f"cluster_capacity_tpu: fused kernel refused for s={pk.meta.s}"
+                f" ({planes} planes exceed the VMEM budget); using XLA scan\n")
+    return ok
+
 
 class KernelMeta(NamedTuple):
     """Everything the kernel specializes on (hashable -> jit cache key)."""
@@ -102,8 +138,10 @@ def _soft_row_domains(ss, c: int) -> int:
     return int(ss.node_domain[c].max()) + 1
 
 
-def eligible(cfg: sim.StaticConfig, pb) -> bool:
-    """Static check: can this problem run on the fused kernel?"""
+def eligible(cfg: sim.StaticConfig, pb, check_vmem: bool = True) -> bool:
+    """Static check: can this problem run on the fused kernel?
+    check_vmem=False skips the plane-budget pass for callers that apply
+    their own (stricter) budget to a shared packing (fused_batched)."""
     mode = os.environ.get("CC_TPU_FUSED", "auto")
     if mode == "0":
         return False
@@ -134,6 +172,11 @@ def eligible(cfg: sim.StaticConfig, pb) -> bool:
     # >2 balanced resources: the XLA path's single sum reduction and the
     # kernel's left-fold could associativity-differ on non-integer fractions.
     if len(cfg.bal_idx) > 2 and sim._weight(cfg, "NodeResourcesBalancedAllocation"):
+        return False
+    # the full plane stack (consts + carry in/out + temporaries) must fit
+    # VMEM — MAX_NODES alone is not an honest cap under heavy constraint
+    # loads (_pack_meta ignores its consts arg, so None is fine here)
+    if check_vmem and not vmem_ok(_pack_meta(cfg, pb, None)):
         return False
     return True
 

@@ -161,6 +161,18 @@ def _batched_solve(pbs: List[enc.EncodedProblem], max_limit: int,
     import jax
     import jax.numpy as jnp
 
+    from ..engine import fused_batched
+
+    # Segment huge groups: bounds the batched kernel's HBM slab AND the
+    # vmapped executable's working set; templates are independent, so
+    # segment results concatenate losslessly.
+    if len(pbs) > fused_batched.MAX_BATCH:
+        out: List[sim.SolveResult] = []
+        for i in range(0, len(pbs), fused_batched.MAX_BATCH):
+            out.extend(_batched_solve(pbs[i:i + fused_batched.MAX_BATCH],
+                                      max_limit, mesh=mesh))
+        return out
+
     sim._ensure_x64(pbs[0].profile)
     pbs, cfg, dnh = _pad_group(pbs)
     consts_list = [sim.build_consts(pb, ss_dnh_min=dnh) for pb in pbs]
@@ -180,18 +192,50 @@ def _batched_solve(pbs: List[enc.EncodedProblem], max_limit: int,
     budget = max(1, min(budget, sim._DEFAULT_UNLIMITED_CAP))
 
     run_chunk = _batched_chunk_runner()
+
+    # The batched fused kernel runs whole chunks for the whole group in one
+    # Pallas call (grid over templates, per-template scalars from SMEM) when
+    # the group is kernel-eligible — BASELINE configs 3/5 ride it on TPU.
+    # Its first min(48, budget) steps are cross-checked against the vmapped
+    # XLA step; divergence or compile failure falls back for this group.
+    bfused = None
+    if mesh is None:
+        bfused = fused_batched.make_batched_runner(
+            cfg, pbs, consts_list, max_dnh=dnh,
+            verify_against=(consts, carry, min(48, budget), run_chunk))
+
     placements: List[List[int]] = [[] for _ in pbs]
     steps_done = 0
     chunk = min(1024, budget)
+    bstate = None
     while steps_done < budget:
-        carry, chosen = run_chunk(cfg, consts, carry, chunk)   # chosen: [n, B]
-        chosen = np.asarray(chosen)
+        if bfused is not None:
+            try:
+                if bstate is None:
+                    bstate = bfused.pack(carry)
+                bstate, chosen, all_stopped = bfused.run_packed(bstate, chunk)
+            except Exception as e:
+                # Lazy Mosaic compile/runtime failure: recover the last
+                # completed chunk's carry and resume on the XLA path.
+                fused_batched._mark_failed(bfused,
+                                           f"{type(e).__name__}: {e}")
+                if bstate is not None:
+                    carry = bfused.unpack(bstate, carry)
+                bfused = None
+                bstate = None
+                continue
+        else:
+            carry, chosen = run_chunk(cfg, consts, carry, chunk)  # [n, B]
+            chosen = np.asarray(chosen)
+            all_stopped = bool(np.all(np.asarray(carry.stopped)))
         for b in range(len(pbs)):
             col = chosen[:, b]
             placements[b].extend(col[col >= 0].tolist())
         steps_done += chunk
-        if bool(np.all(np.asarray(carry.stopped))):
+        if all_stopped:
             break
+    if bstate is not None:
+        carry = bfused.unpack(bstate, carry)
     if max_limit and max_limit > 0:
         placements = [p[:max_limit] for p in placements]
 
