@@ -185,10 +185,15 @@ def eligible(cfg: sim.StaticConfig, pb, check_vmem: bool = True) -> bool:
 # Plane packing
 # ---------------------------------------------------------------------------
 
-def _plane(vec: np.ndarray, s: int, fill: float) -> np.ndarray:
-    out = np.full(s * LANES, fill, dtype=np.float32)
-    out[: vec.shape[0]] = np.asarray(vec, dtype=np.float32)
-    return out.reshape(s, LANES)
+def _plane(vec, s: int, fill: float, xp=np):
+    """Pad a per-node vector to [s, 128].  Works for numpy AND jax.numpy
+    (concatenate instead of slice-assign) so the packers below can run
+    either host-side or on device under jit."""
+    vec = xp.asarray(vec, dtype=xp.float32)
+    pad = s * LANES - vec.shape[0]
+    if pad:
+        vec = xp.concatenate([vec, xp.full((pad,), fill, dtype=xp.float32)])
+    return vec.reshape(s, LANES)
 
 
 class _Packing(NamedTuple):
@@ -293,96 +298,115 @@ def _pack_meta(cfg: sim.StaticConfig, pb, consts) -> _Packing:
                     carry_names=tuple(carry_names))
 
 
-def _pack_consts(pk: _Packing, consts) -> np.ndarray:
+def _pack_consts(pk: _Packing, consts, xp=np):
     meta, cfg = pk.meta, pk.meta.cfg
     s = meta.s
     planes = [None] * len(pk.const_idx)
 
     def put(name, vec, fill=0.0):
-        planes[pk.const_idx[name]] = _plane(np.asarray(vec), s, fill)
+        planes[pk.const_idx[name]] = _plane(vec, s, fill, xp=xp)
 
-    put("static_mask", np.asarray(consts["static_mask"], dtype=np.float32))
+    put("static_mask", xp.asarray(consts["static_mask"], dtype=xp.float32))
     if cfg.volume_filter_on:
-        put("volume_mask", np.asarray(consts["volume_mask"], dtype=np.float32))
+        put("volume_mask", xp.asarray(consts["volume_mask"], dtype=xp.float32))
     if meta.has_taint:
         put("taint_raw", consts["taint_raw"])
     if meta.has_na:
         put("na_raw", consts["na_raw"])
     if meta.has_il:
         put("il_score", consts["il_score"])
-    alloc = np.asarray(consts["allocatable"])
+    alloc = xp.asarray(consts["allocatable"])
     for j in range(meta.r):
         put(f"alloc{j}", alloc[:, j])
     if cfg.spread_hard_n > 0:
-        dom = np.asarray(consts["sh_dom"], dtype=np.float32)
-        countable = np.asarray(consts["sh_countable"], dtype=np.float32)
+        dom = xp.asarray(consts["sh_dom"], dtype=xp.float32)
+        countable = xp.asarray(consts["sh_countable"], dtype=xp.float32)
         for c in range(meta.ch):
             put(f"sh_dom{c}", dom[c], fill=-1.0)
             put(f"sh_countable{c}", countable[c])
-        put("sh_missing", np.asarray(consts["sh_missing"], dtype=np.float32),
+        put("sh_missing", xp.asarray(consts["sh_missing"], dtype=xp.float32),
             fill=1.0)
     if cfg.spread_soft_n > 0:
-        dom = np.asarray(consts["ss_dom"], dtype=np.float32)
-        countable = np.asarray(consts["ss_countable"], dtype=np.float32)
-        existing = np.asarray(consts["ss_node_existing"], dtype=np.float32)
+        dom = xp.asarray(consts["ss_dom"], dtype=xp.float32)
+        countable = xp.asarray(consts["ss_countable"], dtype=xp.float32)
+        existing = xp.asarray(consts["ss_node_existing"], dtype=xp.float32)
         for c in range(meta.cs):
             put(f"ss_dom{c}", dom[c], fill=-1.0)
             put(f"ss_countable{c}", countable[c])
             put(f"ss_existing{c}", existing[c])
-        put("ss_ignored", np.asarray(consts["ss_ignored"], dtype=np.float32),
+        put("ss_ignored", xp.asarray(consts["ss_ignored"], dtype=xp.float32),
             fill=1.0)
     if any(k.startswith("ipa_dom") for k in pk.const_idx):
-        dom = np.asarray(consts["ipa_dom"], dtype=np.float32)
+        dom = xp.asarray(consts["ipa_dom"], dtype=xp.float32)
         for gi in range(meta.g):
             put(f"ipa_dom{gi}", dom[gi], fill=-1.0)
     if cfg.ipa_filter_on:
-        aff_s = np.asarray(consts["ipa_aff_scnt"])
-        anti_s = np.asarray(consts["ipa_anti_scnt"])
+        aff_s = xp.asarray(consts["ipa_aff_scnt"])
+        anti_s = xp.asarray(consts["ipa_anti_scnt"])
         for gi in range(meta.g):
             put(f"ipa_aff_scnt{gi}", aff_s[gi])
             put(f"ipa_anti_scnt{gi}", anti_s[gi])
         put("ipa_eanti_static",
-            np.asarray(consts["ipa_eanti_static"], dtype=np.float32))
+            xp.asarray(consts["ipa_eanti_static"], dtype=xp.float32))
     if meta.has_static_pref:
         put("ipa_static_pref", consts["ipa_static_pref"])
-    return np.stack(planes)
+    return xp.stack(planes)
 
 
-def _pack_carry(pk: _Packing, carry: sim.Carry) -> Tuple[np.ndarray, np.ndarray]:
+def _pack_carry(pk: _Packing, carry: sim.Carry, xp=np):
     meta = pk.meta
     s = meta.s
     planes = [None] * len(pk.carry_idx)
 
     def put(name, vec):
-        planes[pk.carry_idx[name]] = _plane(np.asarray(vec), s, 0.0)
+        planes[pk.carry_idx[name]] = _plane(vec, s, 0.0, xp=xp)
 
-    req = np.asarray(carry.requested)
+    req = xp.asarray(carry.requested)
     for j in range(meta.r):
         put(f"requested{j}", req[:, j])
-    nz = np.asarray(carry.nonzero)
+    nz = xp.asarray(carry.nonzero)
     put("nonzero0", nz[:, 0])
     put("nonzero1", nz[:, 1])
-    put("placed", np.asarray(carry.placed, dtype=np.float32))
+    put("placed", xp.asarray(carry.placed, dtype=xp.float32))
     if "sh_cnt0" in pk.carry_idx:
-        cnt = np.asarray(carry.sh_cnt)
+        cnt = xp.asarray(carry.sh_cnt)
         for c in range(meta.ch):
             put(f"sh_cnt{c}", cnt[c])
     if "ss_cnt0" in pk.carry_idx:
-        cnt = np.asarray(carry.ss_cnt)
+        cnt = xp.asarray(carry.ss_cnt)
         for c in range(meta.cs):
             put(f"ss_cnt{c}", cnt[c])
     for stem, arr in (("aff_cnt", carry.aff_cnt), ("anti_cnt", carry.anti_cnt),
                       ("pref_cnt", carry.pref_cnt)):
         if f"{stem}0" in pk.carry_idx:
-            a = np.asarray(arr)
+            a = xp.asarray(arr)
             for gi in range(meta.g):
                 put(f"{stem}{gi}", a[gi])
-    scalars = np.asarray([[float(np.asarray(carry.placed_count)),
-                           float(bool(np.asarray(carry.stopped))),
-                           float(np.asarray(carry.next_start)),
-                           float(np.asarray(carry.aff_total))]],
-                         dtype=np.float32)
-    return np.stack(planes), scalars
+    scalars = xp.stack([
+        xp.asarray(carry.placed_count, dtype=xp.float32),
+        xp.asarray(carry.stopped, dtype=xp.float32),
+        xp.asarray(carry.next_start, dtype=xp.float32),
+        xp.asarray(carry.aff_total, dtype=xp.float32),
+    ]).reshape(1, 4)
+    return xp.stack(planes), scalars
+
+
+@functools.lru_cache(maxsize=64)
+def _device_const_packer(pk: _Packing):
+    """Jitted on-device const packing.  The host-side packer reads each
+    plane out of device consts separately — through a remote-TPU tunnel
+    that is one ~70 ms round trip PER PLANE; packing on device makes the
+    whole stack build a single dispatch."""
+    import jax
+    import jax.numpy as jnp
+    return jax.jit(lambda consts: _pack_consts(pk, consts, xp=jnp))
+
+
+@functools.lru_cache(maxsize=64)
+def _device_carry_packer(pk: _Packing):
+    import jax
+    import jax.numpy as jnp
+    return jax.jit(lambda carry: _pack_carry(pk, carry, xp=jnp))
 
 
 def _unpack_carry(pk: _Packing, planes: np.ndarray, scalars: np.ndarray,
@@ -391,6 +415,10 @@ def _unpack_carry(pk: _Packing, planes: np.ndarray, scalars: np.ndarray,
     import jax.numpy as jnp
     meta = pk.meta
     n = meta.n
+    # one round trip for both host-bound arrays, not one each
+    for a in (planes, scalars):
+        if hasattr(a, "copy_to_host_async"):
+            a.copy_to_host_async()
     flat = np.asarray(planes).reshape(planes.shape[0], -1)[:, :n]
 
     def rows(stem, count):
@@ -858,9 +886,7 @@ class FusedRunner:
 
     def pack(self, carry: sim.Carry):
         """Carry -> (planes, scalars) device state for run_packed."""
-        import jax.numpy as jnp
-        planes, scalars = _pack_carry(self.pk, carry)
-        return jnp.asarray(planes), jnp.asarray(scalars)
+        return _device_carry_packer(self.pk)(carry)
 
     def unpack(self, state, template: sim.Carry) -> sim.Carry:
         return _unpack_carry(self.pk, state[0], state[1], template)
@@ -868,14 +894,47 @@ class FusedRunner:
     def run_packed(self, state, k_steps: int):
         """One fused chunk on packed device state; no carry round-trip.
         Returns (new_state, chosen[k], stopped)."""
-        import jax.numpy as jnp
+        return self.run_window(state, k_steps, 1)
+
+    def issue_window(self, state, k_steps: int, depth: int):
+        """Issue `depth` chained fused chunks with NO host sync.  Completion
+        latency through a remote-TPU tunnel is ~70 ms per sync while the
+        kernel runs each chunk in single-digit ms; chained dependent calls
+        pipeline on device, so batching chunks per sync — and keeping whole
+        windows in flight while older ones are collected — is the difference
+        between ~13k and >300k steps/s (measured, v5e via axon).  Steps
+        after a stop are no-ops inside the kernel, so speculative chunks
+        past the stop point cost only device time, never correctness.
+        Returns (new_state, window); pass the window to collect()."""
         if self.const_stack is None:
-            self.const_stack = jnp.asarray(_pack_consts(self.pk, self._consts))
+            self.const_stack = _device_const_packer(self.pk)(self._consts)
         call = _compiled_call(self.pk, k_steps, self.interpret)
-        yout, sout, chosen = call(self.const_stack, state[0], state[1])
-        sc = np.asarray(sout)
-        STATS["chunks"] += 1
-        return (yout, sout), np.asarray(chosen)[:, 0], bool(round(sc[0, 1]))
+        planes, scalars = state
+        chunks = []
+        for _ in range(depth):
+            planes, scalars, chosen = call(self.const_stack, planes, scalars)
+            chunks.append(chosen)
+        STATS["chunks"] += depth
+        return (planes, scalars), (scalars, chunks)
+
+    def collect(self, window):
+        """Sync one issued window -> (chosen[k*depth], stopped).  One round
+        trip for ALL the window's host-bound arrays: every device->host copy
+        starts before any blocks (a serial np.asarray per chunk would pay
+        the tunnel RTT depth+1 times)."""
+        scalars, chunks = window
+        for c in chunks:
+            c.copy_to_host_async()
+        sc = np.asarray(scalars)
+        chosen = np.concatenate([np.asarray(c)[:, 0] for c in chunks])
+        return chosen, bool(round(sc[0, 1]))
+
+    def run_window(self, state, k_steps: int, depth: int):
+        """issue_window + collect in one call (the non-pipelined interface).
+        Returns (new_state, chosen[k*depth], stopped)."""
+        state, window = self.issue_window(state, k_steps, depth)
+        chosen, stopped = self.collect(window)
+        return state, chosen, stopped
 
     def run_chunk(self, carry: sim.Carry, k_steps: int):
         state, chosen, _stopped = self.run_packed(self.pack(carry), k_steps)

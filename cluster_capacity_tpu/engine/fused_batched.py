@@ -40,6 +40,23 @@ from .fused import LANES, _BIG, _Packing, _pack_consts, _pack_meta
 # MAX_BATCH-sized segments before reaching this module.
 MAX_BATCH = 256
 
+# Mosaic requires SMEM block sublane counts divisible by 8 (or equal to the
+# array dimension).  The per-template scalar rows therefore move through
+# 8-row tiles: arrays are padded to a multiple of _SMEM_TILE on the template
+# axis and each grid program reads/writes row `program_id % _SMEM_TILE` of
+# block `program_id // _SMEM_TILE`.
+_SMEM_TILE = 8
+
+
+def _pad_rows(arr, xp=np):
+    """Pad [B, W] to [ceil(B/8)*8, W] with zeros."""
+    b = arr.shape[0]
+    pad = -b % _SMEM_TILE
+    if not pad:
+        return arr
+    return xp.concatenate(
+        [arr, xp.zeros((pad, arr.shape[1]), dtype=arr.dtype)])
+
 
 class ScalarTable(NamedTuple):
     """Layout of the per-template SMEM scalar row."""
@@ -148,11 +165,13 @@ def _build_batched_kernel(pk: _Packing, tab: ScalarTable, k_steps: int,
         iota = (jax.lax.broadcasted_iota(jnp.int32, (s, LANES), 0) * LANES
                 + jax.lax.broadcasted_iota(jnp.int32, (s, LANES), 1))
         real = iota < n
+        # scalar rows ride in 8-row SMEM tiles (see _SMEM_TILE)
+        row = jax.lax.rem(pl.program_id(0), _SMEM_TILE)
 
         C = {name: const_ref[0, i] for name, i in ci.items()}
 
         def ts(name, i=0):
-            return tsc_ref[0, off[name] + i]
+            return tsc_ref[row, off[name] + i]
 
         def step(k, state):
             Y, placed_count, stopped, next_start, aff_total = state
@@ -454,15 +473,15 @@ def _build_batched_kernel(pk: _Packing, tab: ScalarTable, k_steps: int,
                     new_aff_total)
 
         Y0 = tuple(yin_ref[0, i] for i in range(n_carry))
-        state = (Y0, sin_ref[0, 0], sin_ref[0, 1], sin_ref[0, 2],
-                 sin_ref[0, 3])
+        state = (Y0, sin_ref[row, 0], sin_ref[row, 1], sin_ref[row, 2],
+                 sin_ref[row, 3])
         Yf, pc, st, ns, at = jax.lax.fori_loop(0, k_steps, step, state)
         for i in range(n_carry):
             yout_ref[0, i] = Yf[i]
-        sout_ref[0, 0] = pc
-        sout_ref[0, 1] = st
-        sout_ref[0, 2] = ns
-        sout_ref[0, 3] = at
+        sout_ref[row, 0] = pc
+        sout_ref[row, 1] = st
+        sout_ref[row, 2] = ns
+        sout_ref[row, 3] = at
 
     return kernel
 
@@ -481,11 +500,13 @@ def _compiled_batched_call(pk: _Packing, tab: ScalarTable, b: int,
     n_carry = len(pk.carry_idx)
     s = meta.s
 
+    b_pad = b + (-b % _SMEM_TILE)
     out_shape = [
         jax.ShapeDtypeStruct((b, n_carry, s, LANES), jnp.float32),
-        jax.ShapeDtypeStruct((b, 4), jnp.float32),
+        jax.ShapeDtypeStruct((b_pad, 4), jnp.float32),
         jax.ShapeDtypeStruct((b, k_steps, 1), jnp.int32),
     ]
+    tile = _SMEM_TILE
     call = pl.pallas_call(
         kernel,
         grid=(b,),
@@ -495,15 +516,15 @@ def _compiled_batched_call(pk: _Packing, tab: ScalarTable, b: int,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, n_carry, s, LANES), lambda i: (i, 0, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 4), lambda i: (i, 0),
+            pl.BlockSpec((tile, 4), lambda i: (i // tile, 0),
                          memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, tab.width), lambda i: (i, 0),
+            pl.BlockSpec((tile, tab.width), lambda i: (i // tile, 0),
                          memory_space=pltpu.SMEM),
         ],
         out_specs=[
             pl.BlockSpec((1, n_carry, s, LANES), lambda i: (i, 0, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 4), lambda i: (i, 0),
+            pl.BlockSpec((tile, 4), lambda i: (i // tile, 0),
                          memory_space=pltpu.SMEM),
             pl.BlockSpec((1, k_steps, 1), lambda i: (i, 0, 0),
                          memory_space=pltpu.VMEM),
@@ -513,49 +534,78 @@ def _compiled_batched_call(pk: _Packing, tab: ScalarTable, b: int,
     return jax.jit(call)
 
 
-def _pack_carry_batched(pk: _Packing, carry) -> Tuple[np.ndarray, np.ndarray]:
+def _plane_b(mat, s: int, xp=np):
+    """[B, N] -> [B, s, 128] zero-padded plane; numpy or jax.numpy."""
+    mat = xp.asarray(mat, dtype=xp.float32)
+    pad = s * LANES - mat.shape[1]
+    if pad:
+        mat = xp.concatenate(
+            [mat, xp.zeros((mat.shape[0], pad), dtype=xp.float32)], axis=1)
+    return mat.reshape(mat.shape[0], s, LANES)
+
+
+def _pack_carry_batched(pk: _Packing, carry, xp=np):
     """Stacked Carry (leading template axis on every leaf) → planes
     [B, P, S, 128] + scalars [B, 4].  Vectorized over the batch — no
-    per-template round-trips."""
+    per-template round-trips; with xp=jax.numpy the whole pack runs on
+    device (see _device_batched_carry_packer)."""
     meta = pk.meta
     s, n = meta.s, meta.n
     yi = pk.carry_idx
-    b = np.asarray(carry.placed).shape[0]
-    planes = np.zeros((b, len(yi), s, LANES), dtype=np.float32)
+    planes = [None] * len(yi)
 
     def put(name, mat):                      # mat: [B, N]
-        buf = np.zeros((b, s * LANES), dtype=np.float32)
-        buf[:, :n] = np.asarray(mat, dtype=np.float32)
-        planes[:, yi[name]] = buf.reshape(b, s, LANES)
+        planes[yi[name]] = _plane_b(mat, s, xp=xp)
 
-    req = np.asarray(carry.requested)        # [B, N, R]
+    req = xp.asarray(carry.requested)        # [B, N, R]
     for j in range(meta.r):
         put(f"requested{j}", req[:, :, j])
-    nz = np.asarray(carry.nonzero)
+    nz = xp.asarray(carry.nonzero)
     put("nonzero0", nz[:, :, 0])
     put("nonzero1", nz[:, :, 1])
-    put("placed", np.asarray(carry.placed))
+    put("placed", xp.asarray(carry.placed))
     if "sh_cnt0" in yi:
-        cnt = np.asarray(carry.sh_cnt)       # [B, Ch, N]
+        cnt = xp.asarray(carry.sh_cnt)       # [B, Ch, N]
         for c in range(meta.ch):
             put(f"sh_cnt{c}", cnt[:, c])
     if "ss_cnt0" in yi:
-        cnt = np.asarray(carry.ss_cnt)
+        cnt = xp.asarray(carry.ss_cnt)
         for c in range(meta.cs):
             put(f"ss_cnt{c}", cnt[:, c])
     for stem, arr in (("aff_cnt", carry.aff_cnt), ("anti_cnt", carry.anti_cnt),
                       ("pref_cnt", carry.pref_cnt)):
         if f"{stem}0" in yi:
-            a = np.asarray(arr)              # [B, G, N]
+            a = xp.asarray(arr)              # [B, G, N]
             for gi in range(meta.g):
                 put(f"{stem}{gi}", a[:, gi])
-    scalars = np.stack([
-        np.asarray(carry.placed_count, dtype=np.float32),
-        np.asarray(carry.stopped, dtype=np.float32),
-        np.asarray(carry.next_start, dtype=np.float32),
-        np.asarray(carry.aff_total, dtype=np.float32),
+    scalars = xp.stack([
+        xp.asarray(carry.placed_count, dtype=xp.float32),
+        xp.asarray(carry.stopped, dtype=xp.float32),
+        xp.asarray(carry.next_start, dtype=xp.float32),
+        xp.asarray(carry.aff_total, dtype=xp.float32),
     ], axis=1)
-    return planes, scalars
+    return xp.stack(planes, axis=1), scalars
+
+
+@functools.lru_cache(maxsize=32)
+def _device_batched_carry_packer(pk: _Packing):
+    """On-device batched carry pack (scalars padded to the SMEM tile) — a
+    host-side pack would pay one tunnel round trip per carry leaf."""
+    import jax
+    import jax.numpy as jnp
+
+    def f(carry):
+        planes, scalars = _pack_carry_batched(pk, carry, xp=jnp)
+        return planes, _pad_rows(scalars, xp=jnp)
+    return jax.jit(f)
+
+
+@functools.lru_cache(maxsize=32)
+def _device_batched_const_packer(pk: _Packing, b: int):
+    import jax
+    import jax.numpy as jnp
+    return jax.jit(lambda cl: jnp.stack(
+        [_pack_consts(pk, c, xp=jnp) for c in cl]))
 
 
 def _unpack_carry_batched(pk: _Packing, planes, scalars, template):
@@ -564,6 +614,9 @@ def _unpack_carry_batched(pk: _Packing, planes, scalars, template):
     meta = pk.meta
     n = meta.n
     yi = pk.carry_idx
+    for a in (planes, scalars):              # one round trip, not two
+        if hasattr(a, "copy_to_host_async"):
+            a.copy_to_host_async()
     pl_np = np.asarray(planes)
     b = pl_np.shape[0]
     flat = pl_np.reshape(b, pl_np.shape[1], -1)[:, :, :n]    # [B, P, N]
@@ -572,7 +625,7 @@ def _unpack_carry_batched(pk: _Packing, planes, scalars, template):
         return np.stack([flat[:, yi[f"{stem}{i}"]] for i in range(count)],
                         axis=1)
 
-    sc = np.asarray(scalars)                 # [B, 4]
+    sc = np.asarray(scalars)[:b]             # [B, 4] (tile padding dropped)
     dt = template.requested.dtype
     requested = np.stack([flat[:, yi[f"requested{j}"]]
                           for j in range(meta.r)], axis=2)   # [B, N, R]
@@ -623,8 +676,8 @@ class BatchedFusedRunner:
                    self.pk.meta.s, self.pk.meta.n, self.pk.meta.cfg,
                    self.max_dnh),
             metas=tuple(pk.meta for pk in pks))
-        self.scalar_rows = np.stack([_scalar_row(self.tab, pk.meta)
-                                     for pk in pks])
+        self.scalar_rows = _pad_rows(np.stack(
+            [_scalar_row(self.tab, pk.meta) for pk in pks]))
         self._consts_list = consts_list
         self.const_stack = None
         if interpret is None:
@@ -632,9 +685,7 @@ class BatchedFusedRunner:
         self.interpret = interpret
 
     def pack(self, carry):
-        import jax.numpy as jnp
-        planes, scalars = _pack_carry_batched(self.pk, carry)
-        return jnp.asarray(planes), jnp.asarray(scalars)
+        return _device_batched_carry_packer(self.pk)(carry)
 
     def unpack(self, state, template):
         return _unpack_carry_batched(self.pk, state[0], state[1], template)
@@ -644,13 +695,17 @@ class BatchedFusedRunner:
         chosen[k_steps, B], all_stopped)."""
         import jax.numpy as jnp
         if self.const_stack is None:
-            self.const_stack = jnp.asarray(np.stack(
-                [_pack_consts(self.pk, c) for c in self._consts_list]))
+            self.const_stack = _device_batched_const_packer(
+                self.pk, self.b)(tuple(self._consts_list))
+            self.scalar_rows_dev = jnp.asarray(self.scalar_rows)
         call = _compiled_batched_call(self.pk, self.tab, self.b, k_steps,
                                       self.max_dnh, self.interpret)
         yout, sout, chosen = call(self.const_stack, state[0], state[1],
-                                  jnp.asarray(self.scalar_rows))
-        sc = np.asarray(sout)
+                                  self.scalar_rows_dev)
+        for a in (sout, chosen):             # one round trip, not two
+            if hasattr(a, "copy_to_host_async"):
+                a.copy_to_host_async()
+        sc = np.asarray(sout)[:self.b]
         fused.STATS["batched_chunks"] = fused.STATS.get("batched_chunks", 0) + 1
         chosen = np.asarray(chosen)[:, :, 0].T          # [k_steps, B]
         return (yout, sout), chosen, bool((sc[:, 1] > 0.5).all())

@@ -43,6 +43,12 @@ FAIL_LIMIT_REACHED = "LimitReached"
 FAIL_UNSCHEDULABLE = "Unschedulable"
 
 _DEFAULT_UNLIMITED_CAP = 1_000_000
+# Fused-kernel chunking: steps per kernel call and max pipelined calls per
+# host sync (measured on v5e-over-tunnel: 4096x8 -> ~325k steps/s vs ~13k/s
+# with a sync per 1024-step chunk).
+_FUSED_CHUNK = 4096
+_FUSED_PIPELINE = 16
+_FUSED_INFLIGHT = 2
 
 
 class StaticConfig(NamedTuple):
@@ -673,34 +679,58 @@ def solve(pb: enc.EncodedProblem, max_limit: int = 0,
             cfg, pb, consts, verify_against=(consts, carry, min(48, budget)))
 
     placements: List[int] = []
-    fused_state = None
-    while len(placements) < budget:
-        if fused_runner is not None:
-            try:
-                if fused_state is None:
-                    fused_state = fused_runner.pack(carry)
-                fused_state, chosen, stopped = fused_runner.run_packed(
-                    fused_state, chunk_size)
-            except Exception as e:
-                # Lazy Mosaic compile/runtime failure: fall back to XLA for
-                # this kernel shape.  fused_state still holds the last
-                # COMPLETED chunk's carry — recover it so the XLA loop
-                # resumes where the kernel left off.
-                fused.mark_failed(fused_runner, f"{type(e).__name__}: {e}")
-                if fused_state is not None:
-                    carry = fused_runner.unpack(fused_state, carry)
-                fused_runner = None
-                fused_state = None
-                continue
-        else:
-            carry, chosen = run_chunk(cfg, consts, carry, chunk_size)
-            stopped = bool(np.asarray(carry.stopped))
+    stopped = False
+    if fused_runner is not None:
+        # Pipelined fused drive: sync latency (remote-TPU tunnels pay ~70 ms
+        # per host round trip) dominates the kernel's per-chunk cost, so (a)
+        # each sync covers a WINDOW of chained chunks, the window doubling
+        # from one chunk up to _FUSED_PIPELINE — an early stop wastes at
+        # most as many speculative steps as were already executed — and (b)
+        # up to _FUSED_INFLIGHT windows stay issued AHEAD of the one being
+        # collected, so each sync's round trip overlaps the device execution
+        # of the windows behind it.  Steps after a stop are no-ops inside
+        # the kernel, so speculation never affects the placement sequence.
+        from collections import deque
+        fused_chunk = min(max(chunk_size, _FUSED_CHUNK), budget)
+        last_good = None
+        try:
+            fused_state = fused_runner.pack(carry)
+            last_good = fused_state
+            inflight: deque = deque()
+            issued = 0
+            depth = 1
+            while True:
+                while (issued < budget and not stopped
+                       and len(inflight) < _FUSED_INFLIGHT):
+                    w = min(depth, -(-(budget - issued) // fused_chunk))
+                    fused_state, window = fused_runner.issue_window(
+                        fused_state, fused_chunk, w)
+                    inflight.append((fused_state, window))
+                    issued += w * fused_chunk
+                    depth = min(depth * 2, _FUSED_PIPELINE)
+                if not inflight:
+                    break
+                state_after, window = inflight.popleft()
+                chosen, stopped = fused_runner.collect(window)
+                last_good = state_after
+                placements.extend(chosen[chosen >= 0].tolist())
+            carry = fused_runner.unpack(last_good, carry)
+        except Exception as e:
+            # Lazy Mosaic compile/runtime failure: fall back to XLA for this
+            # kernel shape.  last_good holds the carry after the last window
+            # whose sync SUCCEEDED — placements collected so far end exactly
+            # there, so the XLA loop below resumes where the kernel left off.
+            fused.mark_failed(fused_runner, f"{type(e).__name__}: {e}")
+            if last_good is not None:
+                carry = fused_runner.unpack(last_good, carry)
+            stopped = False    # unknown at the fallback point; XLA decides
+    while not stopped and len(placements) < budget:
+        carry, chosen = run_chunk(cfg, consts, carry, chunk_size)
+        stopped = bool(np.asarray(carry.stopped))
         chosen = np.asarray(chosen)
         placements.extend(chosen[chosen >= 0].tolist())
         if stopped:
             break
-    if fused_state is not None:
-        carry = fused_runner.unpack(fused_state, carry)
     placements = placements[:budget]
     placed = len(placements)
     stopped = bool(np.asarray(carry.stopped))
