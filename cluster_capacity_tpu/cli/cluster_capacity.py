@@ -79,6 +79,12 @@ def build_parser(prog: str = "cluster-capacity") -> argparse.ArgumentParser:
                         "once.")
     p.add_argument("--period-iterations", dest="period_iterations", type=int,
                    default=0, help=argparse.SUPPRESS)  # test hook: stop after N
+    p.add_argument("--record-golden", dest="record_golden", default="",
+                   help="Write the run as a golden scenario JSON (cluster "
+                        "objects + podspec + profile + observed outcome) "
+                        "that tests/test_golden_scenarios.py replays and a "
+                        "kube-scheduler machine can re-record verbatim. "
+                        "Single --podspec, --snapshot runs only.")
     p.add_argument("--interleave", action="store_true",
                    help="With multiple --podspec: race the templates through "
                         "ONE shared cluster state with scheduling-queue pop "
@@ -151,15 +157,30 @@ def run(argv: Optional[List[str]] = None, prog: str = "cluster-capacity") -> int
               file=sys.stderr)
         return 1
 
+    if args.record_golden and (
+            len(pods) != 1 or not args.snapshot
+            or args.snapshot.endswith(".npz")):
+        print("Error: --record-golden needs exactly one --podspec and a "
+              "YAML/JSON --snapshot (the scenario must carry the raw "
+              "cluster objects)", file=sys.stderr)
+        return 1
+    if args.record_golden and profile.extenders:
+        print("Error: --record-golden cannot serialize profiles with "
+              "extenders", file=sys.stderr)
+        return 1
+
     def one_run():
         if len(pods) == 1:
             cc = ClusterCapacity(pods[0], max_limit=args.max_limit,
                                  profile=profile, exclude_nodes=exclude)
+            raw_objs = None
             if args.snapshot.endswith(".npz"):
                 from ..utils.checkpoint import load as load_checkpoint
                 cc.snapshot = load_checkpoint(args.snapshot)
             elif args.snapshot:
                 objs = load_snapshot_objects(args.snapshot)
+                raw_objs = {k: list(v) for k, v in objs.items()
+                            if isinstance(v, list)}
                 if args.node_order == "zone-round-robin":
                     objs["node_order"] = "zone-round-robin"
                 cc.sync_with_objects(objs.pop("nodes", []),
@@ -169,7 +190,15 @@ def run(argv: Optional[List[str]] = None, prog: str = "cluster-capacity") -> int
             if args.save_snapshot:
                 from ..utils.checkpoint import save as save_checkpoint
                 save_checkpoint(args.save_snapshot, cc.snapshot)
-            cc.run()
+            res = cc.run()
+            if args.record_golden:
+                from ..utils.golden import record_scenario
+                record_scenario(args.record_golden, pods[0], raw_objs,
+                                profile, args.max_limit, res,
+                                exclude_nodes=exclude,
+                                node_order=args.node_order)
+                print(f"golden scenario written to {args.record_golden}",
+                      file=sys.stderr)
             return cc.report()
 
         # multi-template run against one snapshot: independent batched

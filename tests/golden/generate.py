@@ -1,0 +1,215 @@
+"""Regenerate the hand-written golden scenario files.
+
+Each scenario duplicates one inline golden from
+tests/test_golden_reference.py in DATA form so that (a) the scenario runner
+(tests/test_golden_scenarios.py) replays them, and (b) a machine with a Go
+toolchain can replay the identical cluster+pod+profile through a real
+kube-scheduler and commit its decisions verbatim as `<name>.recorded.json`.
+
+The `expected` blocks are copied from the inline tests' assertions — the
+reference-documented outcomes and the hand-derived sequences — NOT from
+running this repo's engine, so they stay independent of the implementation.
+
+Usage:  python tests/golden/generate.py
+"""
+
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(HERE))          # tests/ for helpers
+
+from helpers import build_test_node, build_test_pod  # noqa: E402
+
+PARITY = {"parity": True}
+REDUCED = {"profile": {"score_weights": {"NodeResourcesFit": 1}},
+           "parity": True}
+
+
+def scenario(name, description, derivation, nodes, pod, expected,
+             profile_block=PARITY, max_limit=0):
+    data = {"description": description, "derivation": derivation}
+    data.update(profile_block)
+    data.update({"max_limit": max_limit, "snapshot": {"nodes": nodes},
+                 "pod": pod, "expected": expected})
+    path = os.path.join(HERE, f"{name}.json")
+    with open(path, "w") as f:
+        json.dump(data, f, indent=2)
+        f.write("\n")
+    print(f"wrote {path}")
+
+
+def main():
+    scenario(
+        "readme_demo",
+        "reference README Demonstration: 4 nodes x 2 CPU/4GB, pod "
+        "150m/100Mi -> 52 instances, 13 per node, Insufficient cpu",
+        "reference-doc",
+        [build_test_node(f"kubemark-{i}", 2000, 4 * 1024 ** 3, 110)
+         for i in range(4)],
+        {"metadata": {"name": "small-pod"}, "spec": {"containers": [
+            {"name": "c", "resources": {"requests": {
+                "cpu": "150m", "memory": "100Mi"}}}]}},
+        {"placed_count": 52,
+         "per_node_counts": {f"kubemark-{i}": 13 for i in range(4)},
+         "fail_type": "Unschedulable",
+         "fail_message_contains": "Insufficient cpu"})
+
+    prediction_nodes = [build_test_node("test-node-1", 300, int(1e9), 3),
+                        build_test_node("test-node-2", 400, int(2e9), 3),
+                        build_test_node("test-node-3", 1200, int(1e9), 3)]
+    prediction_pod = build_test_pod("simulated-pod", 100, int(5e6))
+    scenario(
+        "prediction_limit_reached",
+        "pkg/framework/simulator_test.go:154-177 limit=6 -> LimitReached",
+        "reference-doc",
+        prediction_nodes, prediction_pod,
+        {"placed_count": 6, "fail_type": "LimitReached"},
+        max_limit=6)
+    scenario(
+        "prediction_unschedulable",
+        "simulator_test.go unlimited -> Unschedulable; counts + FitError "
+        "derived by hand (3 pod slots/node -> 9; node1 also out of cpu)",
+        "reference-doc + manual-arithmetic",
+        prediction_nodes, prediction_pod,
+        {"placed_count": 9, "fail_type": "Unschedulable",
+         "fail_message": "0/3 nodes are available: 1 Insufficient cpu, "
+                         "3 Too many pods."})
+
+    scenario(
+        "colocation_single_node",
+        "test/benchmark/pod_colocation_test.go:18-93: every replica of a "
+        "self-affine pod lands on ONE node",
+        "reference-doc",
+        [build_test_node(f"node-{i}", 2000, 4 * 1024 ** 3, 20,
+                         labels={"kubernetes.io/hostname": f"node-{i}"})
+         for i in range(5)],
+        {"metadata": {"name": "app", "labels": {"app": "colo"}},
+         "spec": {"containers": [{"name": "c", "resources": {"requests": {
+             "cpu": "100m", "memory": "50Mi"}}}],
+            "affinity": {"podAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": [{
+                    "topologyKey": "kubernetes.io/hostname",
+                    "labelSelector": {"matchLabels": {"app": "colo"}}}]}}}},
+        {"one_node": True})
+    scenario(
+        "colocation_one_zone",
+        "pod_colocation_test.go:95-190: zone self-affinity over 9 nodes / "
+        "3 zones -> one zone",
+        "reference-doc",
+        [build_test_node(f"zn-{i}", 1000, 4 * 1024 ** 3, 20,
+                         labels={"kubernetes.io/hostname": f"zn-{i}",
+                                 "topology.kubernetes.io/zone":
+                                     f"zone-{i % 3}"})
+         for i in range(9)],
+        {"metadata": {"name": "zapp", "labels": {"app": "zcolo"}},
+         "spec": {"containers": [{"name": "c", "resources": {"requests": {
+             "cpu": "100m"}}}],
+            "affinity": {"podAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": [{
+                    "topologyKey": "topology.kubernetes.io/zone",
+                    "labelSelector": {"matchLabels": {"app": "zcolo"}}}]}}}},
+        {"one_zone": True})
+
+    scenario(
+        "least_allocated_sequence",
+        "hand-derived LeastAllocated greedy order (least_allocated.go:30-60 "
+        "incl. the incoming pod): first 12 = n0 x11 then n1; derivation in "
+        "tests/test_golden_reference.py:114-140",
+        "manual-arithmetic",
+        [build_test_node("n0", 10000, int(1e12), 200),
+         build_test_node("n1", 1000, int(1e12), 200)],
+        build_test_pod("p", 100, -1),
+        {"placements": ["n0"] * 11 + ["n1"]},
+        profile_block=REDUCED, max_limit=12)
+
+    scenario(
+        "spread_skew_sequence",
+        "hand-derived skew-rule trace (filtering.go:311-357): n0,n1,n0,n1,"
+        "n0 then a three-way FitError; derivation in "
+        "tests/test_golden_reference.py:143-184",
+        "manual-arithmetic",
+        [build_test_node("n0", 10000, int(1e12), 200,
+                         labels={"kubernetes.io/hostname": "n0",
+                                 "topology.kubernetes.io/zone": "z0"}),
+         build_test_node("n1", 1000, int(1e12), 2,
+                         labels={"kubernetes.io/hostname": "n1",
+                                 "topology.kubernetes.io/zone": "z1"})],
+        {"metadata": {"name": "p", "labels": {"app": "s"},
+                      "namespace": "default"},
+         "spec": {"containers": [{"name": "c", "resources": {"requests": {
+             "cpu": "500m"}}}],
+            "topologySpreadConstraints": [{
+                "maxSkew": 1, "topologyKey": "topology.kubernetes.io/zone",
+                "whenUnsatisfiable": "DoNotSchedule",
+                "labelSelector": {"matchLabels": {"app": "s"}}}]}},
+        {"placements": ["n0", "n1", "n0", "n1", "n0"],
+         "fail_message": "0/2 nodes are available: 1 Insufficient cpu, "
+                         "1 Too many pods, 1 node(s) didn't match pod "
+                         "topology spread constraints."},
+        profile_block=REDUCED)
+
+    scenario(
+        "anti_affinity_one_per_zone",
+        "required zone anti-affinity against own selector -> one clone per "
+        "zone in node-index order, then anti-affinity FitError",
+        "manual-arithmetic",
+        [build_test_node(f"n{i}", 2000, 4 * 1024 ** 3, 20,
+                         labels={"kubernetes.io/hostname": f"n{i}",
+                                 "topology.kubernetes.io/zone": f"z{i % 3}"})
+         for i in range(6)],
+        {"metadata": {"name": "p", "labels": {"app": "a"},
+                      "namespace": "default"},
+         "spec": {"containers": [{"name": "c", "resources": {"requests": {
+             "cpu": "100m"}}}],
+            "affinity": {"podAntiAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": [{
+                    "topologyKey": "topology.kubernetes.io/zone",
+                    "labelSelector": {"matchLabels": {"app": "a"}}}]}}}},
+        {"placements": ["n0", "n1", "n2"],
+         "fail_message": "0/6 nodes are available: 6 node(s) didn't match "
+                         "pod anti-affinity rules."},
+        profile_block=REDUCED)
+
+    fpga_pod = build_test_pod("p", 100, 0)
+    fpga_pod["spec"]["containers"][0]["resources"]["requests"][
+        "example.com/fpga"] = "1"
+    scenario(
+        "missing_extended_resource",
+        "fit.go:585-600: unpublished extended resource reads as 0 "
+        "allocatable -> Insufficient example.com/fpga on every node",
+        "manual-arithmetic",
+        [build_test_node(f"n{i}", 2000, 4 * 1024 ** 3, 20) for i in range(3)],
+        fpga_pod,
+        {"placed_count": 0,
+         "fail_message": "0/3 nodes are available: "
+                         "3 Insufficient example.com/fpga."})
+
+    scenario(
+        "preferred_anti_affinity_round_robin",
+        "hand-derived min-max-normalized preferred anti-affinity rotation "
+        "(scoring.go:268-300): n0,n1,n2,n0,n1,n2; derivation in "
+        "tests/test_golden_reference.py:230-268",
+        "manual-arithmetic",
+        [build_test_node(f"n{i}", 4000, int(1e12), 2,
+                         labels={"kubernetes.io/hostname": f"n{i}"})
+         for i in range(3)],
+        {"metadata": {"name": "p", "labels": {"app": "rr"},
+                      "namespace": "default"},
+         "spec": {"containers": [{"name": "c", "resources": {"requests": {
+             "cpu": "100m"}}}],
+            "affinity": {"podAntiAffinity": {
+                "preferredDuringSchedulingIgnoredDuringExecution": [{
+                    "weight": 10, "podAffinityTerm": {
+                        "topologyKey": "kubernetes.io/hostname",
+                        "labelSelector": {"matchLabels": {"app": "rr"}}}}]
+            }}}},
+        {"placements": ["n0", "n1", "n2", "n0", "n1", "n2"],
+         "fail_message": "0/3 nodes are available: 3 Too many pods."},
+        profile_block={"profile": {"score_weights": {"InterPodAffinity": 2}},
+                       "parity": True})
+
+
+if __name__ == "__main__":
+    main()
