@@ -150,11 +150,11 @@ def bench_scan(platform: str, with_spread: bool = False,
     # XLA scan is ~1000x slower per step than the fused TPU kernel).
     budget = int(os.environ.get(
         "BENCH_SCAN_STEPS", "100000" if platform not in ("cpu",) else "2000"))
-    # Warmup must cover BOTH compiled shapes the measured solve will use:
-    # the 48-step verify kernel and the full-size fused chunk (the fused
-    # chunk size caps at the budget, so a tiny warmup budget would leave the
-    # big kernel's Mosaic compile inside the measured window).
-    sim.solve(pb, max_limit=min(2 * sim._FUSED_CHUNK, budget))
+    # Warmup at the FULL budget: it must cover every compiled shape (48-step
+    # verify kernel + full-size fused chunk) AND the one-time mid-solve
+    # verification checkpoints, all memoized per kernel shape — otherwise
+    # the measured solve pays them.
+    sim.solve(pb, max_limit=budget)
     chunks_before = fused.STATS["chunks"]
     t0 = time.perf_counter()
     res = sim.solve(pb, max_limit=budget)

@@ -23,6 +23,7 @@ shape — reuse the compiled executable.
 from __future__ import annotations
 
 import functools
+import os
 from dataclasses import dataclass, field
 from typing import Dict, List, NamedTuple, Optional, Tuple
 
@@ -45,8 +46,9 @@ FAIL_UNSCHEDULABLE = "Unschedulable"
 _DEFAULT_UNLIMITED_CAP = 1_000_000
 # Fused-kernel chunking: steps per kernel call and max pipelined calls per
 # host sync (measured on v5e-over-tunnel: 4096x8 -> ~325k steps/s vs ~13k/s
-# with a sync per 1024-step chunk).
-_FUSED_CHUNK = 4096
+# with a sync per 1024-step chunk).  Env override is a test hook (small
+# chunks make the mid-solve checkpoints reachable in interpret mode).
+_FUSED_CHUNK = int(os.environ.get("CC_TPU_FUSED_CHUNK", "4096"))
 _FUSED_PIPELINE = 16
 _FUSED_INFLIGHT = 2
 
@@ -692,12 +694,29 @@ def solve(pb: enc.EncodedProblem, max_limit: int = 0,
         # the kernel, so speculation never affects the placement sequence.
         from collections import deque
         fused_chunk = min(max(chunk_size, _FUSED_CHUNK), budget)
+        # Mid-solve re-verification (VERDICT r2 weak #2): at each checkpoint
+        # the solve snapshots the carry, then compares the NEXT window's
+        # first 48 fused placements against the XLA step run from that
+        # snapshot.  A divergence proves the kernel wrong somewhere, so
+        # EVERYTHING it produced is suspect: the solve restarts from the
+        # initial carry on pure XLA (mark_failed bans the shape).  Keyed by
+        # kernel shape AND problem content — different cluster data under
+        # the same shape re-verifies.
+        verify_key = (fused_runner.pk.meta, fused_runner.interpret,
+                      fused.problem_fingerprint(pb))
+        done_ckpts = fused._verified_windows.setdefault(verify_key, set())
+        ckpts = [c for c in fused.verify_checkpoints(budget, fused_chunk)
+                 if c not in done_ckpts]
+        pending = None          # (carry at snapshot, checkpoint step)
+        carry0 = carry
+        diverged = False
         last_good = None
         try:
             fused_state = fused_runner.pack(carry)
             last_good = fused_state
             inflight: deque = deque()
             issued = 0
+            steps_done = 0
             depth = 1
             while True:
                 while (issued < budget and not stopped
@@ -712,9 +731,37 @@ def solve(pb: enc.EncodedProblem, max_limit: int = 0,
                     break
                 state_after, window = inflight.popleft()
                 chosen, stopped = fused_runner.collect(window)
+                if pending is not None:
+                    carry_v, ckpt = pending
+                    pending = None
+                    w_v = min(48, len(chosen))
+                    _xc, x_chosen = run_chunk(cfg, consts, carry_v, w_v)
+                    if not np.array_equal(np.asarray(x_chosen),
+                                          chosen[:w_v]):
+                        fused.mark_failed(
+                            fused_runner, "mid-solve cross-check divergence "
+                            f"at checkpoint step {ckpt}")
+                        diverged = True
+                        break
+                    done_ckpts.add(ckpt)
+                    fused.STATS["verified_windows"].append(
+                        (ckpt, fused_runner.pk.meta.n))
                 last_good = state_after
                 placements.extend(chosen[chosen >= 0].tolist())
-            carry = fused_runner.unpack(last_good, carry)
+                steps_done += len(chosen)
+                nxt = next((c for c in ckpts
+                            if c <= steps_done and c not in done_ckpts),
+                           None)
+                if nxt is not None and not stopped:
+                    pending = (fused_runner.unpack(last_good, carry), nxt)
+            if not diverged:
+                carry = fused_runner.unpack(last_good, carry)
+            else:
+                # a proven divergence taints every fused placement, not just
+                # the window it was caught in — restart clean on XLA
+                placements.clear()
+                carry = carry0
+                stopped = False
         except Exception as e:
             # Lazy Mosaic compile/runtime failure: fall back to XLA for this
             # kernel shape.  last_good holds the carry after the last window

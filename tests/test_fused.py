@@ -413,3 +413,96 @@ def test_pack_unpack_roundtrip():
         a = np.asarray(getattr(carry, name))
         b = np.asarray(getattr(back, name))
         assert np.array_equal(a, b), name
+
+
+# ---------------------------------------------------------------------------
+# Mid-solve verification checkpoints (VERDICT r2 weak #2)
+# ---------------------------------------------------------------------------
+
+def _ckpt_problem():
+    nodes = _nodes(6, seed=11)
+    pod = {"metadata": {"name": "p", "labels": {"app": "ck"}},
+           "spec": {"containers": [{"name": "c", "resources": {"requests": {
+               "cpu": "10m"}}}]}}
+    snap = ClusterSnapshot.from_objects(nodes)
+    pb = enc.encode_problem(snap, default_pod(pod), SchedulerProfile())
+    return pb
+
+
+def test_verify_checkpoints_shape():
+    assert fused.verify_checkpoints(100000, 4096) == (4096, 16384, 65536)
+    assert fused.verify_checkpoints(300000, 4096) == (
+        4096, 16384, 65536, 262144)
+    assert fused.verify_checkpoints(1000, 4096) == ()
+    assert fused.verify_checkpoints(200, 32) == (32,)
+
+
+def test_midsolve_checkpoint_verifies(monkeypatch):
+    """With a small fused chunk, a long solve crosses checkpoints and each
+    gets verified against the XLA step exactly once per kernel shape."""
+    monkeypatch.setenv("CC_TPU_FUSED", "1")
+    monkeypatch.setattr(sim, "_FUSED_CHUNK", 32)
+    monkeypatch.setattr(
+        fused, "verify_checkpoints",
+        lambda budget, chunk: tuple(c for c in (chunk, 96) if c < budget))
+    fused._verified_windows.clear()
+    before = len(fused.STATS["verified_windows"])
+    pb = _ckpt_problem()
+    r1 = sim.solve(pb, max_limit=200, chunk_size=32)
+    windows = fused.STATS["verified_windows"][before:]
+    assert [c for c, _n in windows] == [32, 96]
+    monkeypatch.setenv("CC_TPU_FUSED", "0")
+    r2 = sim.solve(pb, max_limit=200, chunk_size=32)
+    assert r1.placements == r2.placements
+    monkeypatch.setenv("CC_TPU_FUSED", "1")
+    # second solve of the SAME problem: checkpoints memoized, no re-pay
+    before = len(fused.STATS["verified_windows"])
+    sim.solve(pb, max_limit=200, chunk_size=32)
+    assert fused.STATS["verified_windows"][before:] == []
+    # same kernel shape but DIFFERENT cluster data: must re-verify (the
+    # memo key includes a problem fingerprint, review-found gap)
+    nodes2 = _nodes(6, seed=12)
+    snap2 = ClusterSnapshot.from_objects(nodes2)
+    pod2 = {"metadata": {"name": "p", "labels": {"app": "ck"}},
+            "spec": {"containers": [{"name": "c", "resources": {"requests": {
+                "cpu": "10m"}}}]}}
+    pb2 = enc.encode_problem(snap2, default_pod(pod2), SchedulerProfile())
+    before = len(fused.STATS["verified_windows"])
+    sim.solve(pb2, max_limit=200, chunk_size=32)
+    assert [c for c, _n in fused.STATS["verified_windows"][before:]] \
+        == [32, 96]
+
+
+def test_midsolve_divergence_falls_back(monkeypatch):
+    """A kernel that goes wrong AFTER the initial 48-step check is caught at
+    the next checkpoint: placements truncate to the verified snapshot and
+    the XLA scan finishes the solve — the final answer matches pure XLA."""
+    monkeypatch.setenv("CC_TPU_FUSED", "1")
+    monkeypatch.setattr(sim, "_FUSED_CHUNK", 32)
+    monkeypatch.setattr(
+        fused, "verify_checkpoints",
+        lambda budget, chunk: (chunk,) if chunk < budget else ())
+    fused._verified_windows.clear()
+    fused._failed_metas.clear()
+    pb = _ckpt_problem()
+
+    orig_collect = fused.FusedRunner.collect
+
+    def corrupt_collect(self, window):
+        chosen, stopped = orig_collect(self, window)
+        calls[0] += 1
+        if calls[0] >= 2:       # windows after the first: corrupt the trace
+            chosen = chosen.copy()
+            chosen[: len(chosen) // 2] = 0
+        return chosen, stopped
+
+    calls = [0]
+    monkeypatch.setattr(fused.FusedRunner, "collect", corrupt_collect)
+    r1 = sim.solve(pb, max_limit=200, chunk_size=32)
+    monkeypatch.setattr(fused.FusedRunner, "collect", orig_collect)
+
+    monkeypatch.setenv("CC_TPU_FUSED", "0")
+    r2 = sim.solve(pb, max_limit=200, chunk_size=32)
+    assert r1.placements == r2.placements
+    assert r1.fail_message == r2.fail_message
+    fused._failed_metas.clear()
