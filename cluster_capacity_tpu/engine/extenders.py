@@ -258,6 +258,23 @@ def _kept_names(verdict: Dict) -> Optional[List[str]]:
     return None
 
 
+def make_node_ok(extenders, pod: dict, node_names: List[str], nodes):
+    """Preemption-candidate veto from the extender filter chain: returns a
+    `node_ok(name) -> bool` callback, or None without extenders.  Shared by
+    framework._solve_with_preemption and oracle.simulate_with_preemption so
+    the differential pair cannot drift (preemption.go consults supporting
+    extenders during victim selection)."""
+    if not extenders:
+        return None
+    passing = frozenset(run_filter_chain(
+        extenders, pod, list(node_names),
+        {n: o for n, o in zip(node_names, nodes)}))
+
+    def node_ok(name, _passing=passing):
+        return name in _passing
+    return node_ok
+
+
 def run_filter_chain(extenders, pod: dict, node_names: List[str],
                      node_objects: Optional[Dict[str, dict]] = None
                      ) -> List[str]:

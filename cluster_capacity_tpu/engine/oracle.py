@@ -639,10 +639,18 @@ def simulate_with_preemption(snapshot: ClusterSnapshot, template: dict,
     differential target for framework._solve_with_preemption.
 
     `snapshot_options` carries from_objects ordering options (node_order,
-    sort_nodes) so the oracle's node axis matches the engine's."""
+    sort_nodes) so the oracle's node axis matches the engine's.
+
+    Extenders: preemption-supporting extenders from the profile are
+    consulted exactly as the framework consults them (filter-chain node
+    veto + ProcessPreemption victim veto).  Only preempt-only extenders are
+    faithful here — simulate() does not model extender Filter/Prioritize,
+    so profiles whose extenders filter or score nodes are out of this
+    oracle's scope (solve_with_extenders has its own depth tests)."""
     from . import preemption as pre
 
     profile = profile or SchedulerProfile.parity()
+    extenders = list(profile.extenders or [])
     placements: List[int] = []
     reasons: Dict[str, int] = {}
     working_pods = [p for plist in snapshot.pods_by_node for p in plist]
@@ -665,12 +673,27 @@ def simulate_with_preemption(snapshot: ClusterSnapshot, template: dict,
             clone = ps.make_clone(template, clone_seq + j)
             clone["spec"]["nodeName"] = snap.node_names[idx]
             state_pods[idx].append(clone)
-        outcome = pre.evaluate(snap, state_pods, template, profile)
+        from .extenders import make_node_ok
+        outcome = pre.evaluate(
+            snap, state_pods, template, profile,
+            node_ok=make_node_ok(extenders, template, snap.node_names,
+                                 snap.nodes),
+            extenders=extenders)
         if not outcome.succeeded:
             return placements, reasons
+        # identity OR (namespace, name, uid): extender ProcessPreemption
+        # responses round-trip victims through JSON, so id() alone would
+        # evict nothing and the loop would spin forever
         victim_ids = {id(v) for v in outcome.victims}
+        victim_keys = {k for v in outcome.victims
+                       if (k := pre.pod_key(v)) is not None}
+        before = sum(len(pl) for pl in snap.pods_by_node)
         working_pods = [p for plist in snap.pods_by_node for p in plist
-                        if id(p) not in victim_ids]
+                        if id(p) not in victim_ids
+                        and pre.pod_key(p) not in victim_keys]
+        if len(working_pods) == before and not got:
+            # nothing evicted and nothing placed: cannot progress
+            return placements, reasons
         for idx in got:
             clone = ps.make_clone(template, clone_seq)
             clone_seq += 1

@@ -49,6 +49,21 @@ _UNRESOLVABLE_REASONS = (
 )
 
 
+def pod_key(pod: Mapping):
+    """Identity key for victim matching; None when the pod has neither a
+    name nor a uid — a metadata-less key would match every other
+    metadata-less pod and evict them all, so such pods only ever match by
+    object identity (id()).  Shared by the framework loop and the oracle's
+    sequential equivalent: extender ProcessPreemption responses round-trip
+    victims through JSON, so id() alone would evict nothing and spin."""
+    meta = pod.get("metadata") or {}
+    name = meta.get("name", "")
+    uid = meta.get("uid", "")
+    if not name and not uid:
+        return None
+    return (meta.get("namespace") or "default", name, uid)
+
+
 def resolve_priority(pod: Mapping, priority_classes: Sequence[Mapping]) -> int:
     """Pod priority: spec.priority, else priorityClassName lookup, else the
     globalDefault class, else 0."""

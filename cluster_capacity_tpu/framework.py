@@ -13,6 +13,7 @@ from typing import List, Optional, Sequence
 
 from .engine.encode import encode_problem
 from .engine.fast_path import solve_auto
+from .engine.preemption import pod_key as _pod_key
 from .engine.simulator import SolveResult
 from .models.podspec import default_pod, load_pod_yaml, parse_pod_text, validate_pod
 from .models import snapshot as snapshot_mod
@@ -164,20 +165,11 @@ class ClusterCapacity:
                 clone = make_clone(self.pod, clone_seq + j)
                 clone["spec"]["nodeName"] = snap.node_names[idx]
                 state_pods[idx].append(clone)
-            node_ok = None
-            if profile.extenders:
-                # veto candidates the extender webhooks reject — one batched
-                # filter call per extender per round (preemption.go consults
-                # supporting extenders during victim selection)
-                from .engine.extenders import run_filter_chain
-                passing = run_filter_chain(profile.extenders, self.pod,
-                                           list(snap.node_names),
-                                           {n: o for n, o in
-                                            zip(snap.node_names, snap.nodes)})
-                def node_ok(name, _passing=frozenset(passing)):
-                    return name in _passing
+            from .engine.extenders import make_node_ok
             outcome = evaluate(snap, state_pods, self.pod, profile,
-                               node_ok=node_ok,
+                               node_ok=make_node_ok(
+                                   profile.extenders, self.pod,
+                                   snap.node_names, snap.nodes),
                                extenders=profile.extenders)
             from .utils.events import (REASON_FAILED_SCHEDULING,
                                        REASON_PREEMPTED, default_recorder)
@@ -266,19 +258,6 @@ class ClusterCapacity:
         no informers, goroutines, or channels exist in this design."""
         self.snapshot = None
         self._result = None
-
-
-def _pod_key(pod: dict):
-    """Identity key for victim matching; None when the pod has neither a
-    name nor a uid — a metadata-less key would match every other
-    metadata-less pod and evict them all, so such pods only ever match
-    by object identity (id())."""
-    meta = pod.get("metadata") or {}
-    name = meta.get("name", "")
-    uid = meta.get("uid", "")
-    if not name and not uid:
-        return None
-    return (meta.get("namespace") or "default", name, uid)
 
 
 def _to_dict(obj):
