@@ -229,8 +229,40 @@ def _scenario_sweep():
             "batched_fused": bool(batched)}
 
 
+def _scenario_parity():
+    """Parity-protocol evidence on the bench cluster itself: the f32 engine
+    (fused kernel on TPU) must place identically to the f64 parity
+    protocol.  Together with the fused==XLA-f32 runtime cross-checks, this
+    makes the headline f32 number a parity-protocol number.  (TPU has no
+    native f64 — the f64 side runs emulated/slow, so its budget is small.)"""
+    from cluster_capacity_tpu.engine import simulator as sim
+    from cluster_capacity_tpu.utils.config import SchedulerProfile
+
+    budget = int(os.environ.get("BENCH_PARITY_STEPS", "2000"))
+    pb32 = build_problem(with_spread=True)
+    r32 = sim.solve(pb32, max_limit=budget)
+
+    from cluster_capacity_tpu.engine.encode import encode_problem
+    snap = pb32.snapshot
+    pb64 = encode_problem(snap, pb32.pod, SchedulerProfile.parity())
+    r64 = sim.solve(pb64, max_limit=budget)
+    matches = r32.placements == r64.placements
+    first_div = None
+    if not matches:
+        # a pure length difference means the divergence is the common
+        # prefix's end, not an unequal pair
+        first_div = next(
+            (i for i, (a, b) in enumerate(
+                zip(r32.placements, r64.placements)) if a != b),
+            min(len(r32.placements), len(r64.placements)))
+    return {"f32_matches_f64": bool(matches),
+            "steps_compared": min(len(r32.placements), len(r64.placements)),
+            "first_divergence": first_div}
+
+
 _SCENARIOS = {"fast": _scenario_fast, "scan": _scenario_scan,
-              "ipa": _scenario_ipa, "sweep": _scenario_sweep}
+              "ipa": _scenario_ipa, "sweep": _scenario_sweep,
+              "parity": _scenario_parity}
 
 
 def _child_platform() -> str:
@@ -289,6 +321,7 @@ def main() -> None:
         sc = _run_scenario("scan", False, timeout)
     ipa = _run_scenario("ipa", accel, timeout)
     sw = _run_scenario("sweep", accel, timeout)
+    par = _run_scenario("parity", accel, timeout)
 
     platform = (sc or fp or ipa or sw or {}).get("platform", "none")
     sc_pps = (sc or {}).get("pps", 0.0)
@@ -319,6 +352,11 @@ def main() -> None:
         out["sweep_spread_templates"] = sw["templates"]
         out["sweep_spread_nodes"] = sw["nodes"]
         out["sweep_batched_fused_kernel"] = sw["batched_fused"]
+    if par:
+        out["parity_f32_matches_f64"] = par["f32_matches_f64"]
+        out["parity_steps_compared"] = par["steps_compared"]
+        if par.get("first_divergence") is not None:
+            out["parity_first_divergence"] = par["first_divergence"]
     print(json.dumps(out))
 
 

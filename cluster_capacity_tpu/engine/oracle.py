@@ -681,16 +681,10 @@ def simulate_with_preemption(snapshot: ClusterSnapshot, template: dict,
             extenders=extenders)
         if not outcome.succeeded:
             return placements, reasons
-        # identity OR (namespace, name, uid): extender ProcessPreemption
-        # responses round-trip victims through JSON, so id() alone would
-        # evict nothing and the loop would spin forever
-        victim_ids = {id(v) for v in outcome.victims}
-        victim_keys = {k for v in outcome.victims
-                       if (k := pre.pod_key(v)) is not None}
+        is_victim = pre.victim_matcher(outcome.victims)
         before = sum(len(pl) for pl in snap.pods_by_node)
         working_pods = [p for plist in snap.pods_by_node for p in plist
-                        if id(p) not in victim_ids
-                        and pre.pod_key(p) not in victim_keys]
+                        if not is_victim(p)]
         if len(working_pods) == before and not got:
             # nothing evicted and nothing placed: cannot progress
             return placements, reasons

@@ -64,6 +64,21 @@ def pod_key(pod: Mapping):
     return (meta.get("namespace") or "default", name, uid)
 
 
+def victim_matcher(victims: Sequence[Mapping]):
+    """Predicate `is_victim(pod) -> bool` matching by object identity OR
+    (namespace, name, uid) key.  Extender ProcessPreemption responses
+    round-trip victims through JSON, so id() alone would evict nothing and
+    the preemption loop would spin forever; metadata-less pods only ever
+    match by identity (see pod_key).  Shared by the framework loop and the
+    oracle's sequential equivalent so the differential pair cannot drift."""
+    ids = {id(v) for v in victims}
+    keys = {k for v in victims if (k := pod_key(v)) is not None}
+
+    def is_victim(pod: Mapping) -> bool:
+        return id(pod) in ids or pod_key(pod) in keys
+    return is_victim
+
+
 def resolve_priority(pod: Mapping, priority_classes: Sequence[Mapping]) -> int:
     """Pod priority: spec.priority, else priorityClassName lookup, else the
     globalDefault class, else 0."""

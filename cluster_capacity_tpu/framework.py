@@ -188,18 +188,15 @@ class ClusterCapacity:
                         snap.num_nodes, outcome.message_counts)
                 break
             # evict victims and resume; clones placed so far become pods.
-            # Victims match by object identity OR (namespace, name, uid) —
-            # extender ProcessPreemption responses round-trip pods through
-            # JSON, so id() alone would evict nothing and spin forever.
+            # Victim matching: engine/preemption.victim_matcher (identity OR
+            # namespace/name/uid key — shared with the oracle differential).
             # Only the touched nodes' rows change → incremental re-snapshot
             # (models.snapshot.with_pods_by_node; cache.go:194 analog); the
             # full rebuild is the fallback when vocab/shared-claim rules
             # prevent it.
-            victim_ids = {id(v) for v in outcome.victims}
-            victim_keys = {k for v in outcome.victims
-                           if (k := _pod_key(v)) is not None}
-            new_pbn = [[p for p in plist if id(p) not in victim_ids
-                        and _pod_key(p) not in victim_keys]
+            from .engine.preemption import victim_matcher
+            is_victim = victim_matcher(outcome.victims)
+            new_pbn = [[p for p in plist if not is_victim(p)]
                        for plist in snap.pods_by_node]
             changed = {i for i, plist in enumerate(snap.pods_by_node)
                        if len(new_pbn[i]) != len(plist)}
