@@ -275,6 +275,35 @@ def make_node_ok(extenders, pod: dict, node_names: List[str], nodes):
     return node_ok
 
 
+# FitError bucket for nodes the in-tree filters accepted but an extender's
+# Filter verb rejected (extender.go FailedNodes carry per-extender messages;
+# this single bucket is the reduced model shared by the solve/interleave
+# paths).
+REASON_EXTENDER_FILTER = "node(s) didn't pass the extender filter"
+
+
+def run_prioritize_chain(extenders, pod: dict,
+                         node_names: List[str]) -> Dict[str, float]:
+    """Weighted extender Prioritize sum per node name (prioritizeNodes,
+    schedule_one.go:819-877).  Single source for solve_with_extenders and
+    the interleaved queue sweep so the two paths cannot drift."""
+    bonus = {n: 0.0 for n in node_names}
+    for ext in extenders:
+        if not (ext.prioritize_verb or ext.prioritize_callable):
+            continue
+        if not ext.is_interested(pod):
+            continue
+        try:
+            for hp in ext.prioritize(pod, list(node_names)):
+                nm = hp.get("Host")
+                if nm in bonus:
+                    bonus[nm] += ext.weight * float(hp.get("Score", 0))
+        except Exception:
+            if not ext.ignorable:
+                raise
+    return bonus
+
+
 def run_filter_chain(extenders, pod: dict, node_names: List[str],
                      node_objects: Optional[Dict[str, dict]] = None
                      ) -> List[str]:
@@ -336,6 +365,7 @@ def solve_with_extenders(pb: enc.EncodedProblem,
     budget = max(1, min(budget, sim._DEFAULT_UNLIMITED_CAP))
 
     placements: List[int] = []
+    ext_blocked = 0        # in-tree-feasible nodes the extenders rejected
     while len(placements) < budget:
         feasible, total = compute(cfg, consts, carry)
         feasible = np.asarray(feasible).copy()
@@ -351,22 +381,11 @@ def solve_with_extenders(pb: enc.EncodedProblem,
             for nm in feasible_names:
                 if nm not in keep:
                     feasible[name_to_idx[nm]] = False
-            feasible_names = surviving
-        for ext in extenders:
-            if not (ext.prioritize_verb or ext.prioritize_callable):
-                continue
-            if not ext.is_interested(pb.pod):
-                continue
-            try:
-                for hp in ext.prioritize(pb.pod, feasible_names):
-                    nm = hp.get("Host")
-                    if nm in name_to_idx:
-                        total[name_to_idx[nm]] += \
-                            ext.weight * float(hp.get("Score", 0))
-            except Exception:
-                if not ext.ignorable:
-                    raise
+        for nm, b in run_prioritize_chain(extenders, pb.pod,
+                                          surviving).items():
+            total[name_to_idx[nm]] += b
         if not feasible.any():
+            ext_blocked = len(feasible_names)
             break
 
         # -inf sentinel: extender scores may push totals negative
@@ -387,6 +406,12 @@ def solve_with_extenders(pb: enc.EncodedProblem,
             fail_message=f"Maximum number of pods simulated: {max_limit}",
             node_names=names)
     counts = sim.diagnose(pb, cfg, consts, carry)
+    if ext_blocked:
+        # the solve ended with in-tree-feasible nodes left: only the
+        # extender Filter chain rejected them (same bucket as the
+        # interleaved path's accounting)
+        counts = dict(counts)
+        counts[REASON_EXTENDER_FILTER] = ext_blocked
     msg = sim.format_fit_error(pb.snapshot.num_nodes, counts)
     return sim.SolveResult(
         placements=placements, placed_count=placed,

@@ -13,7 +13,6 @@ from typing import List, Optional, Sequence
 
 from .engine.encode import encode_problem
 from .engine.fast_path import solve_auto
-from .engine.preemption import pod_key as _pod_key
 from .engine.simulator import SolveResult
 from .models.podspec import default_pod, load_pod_yaml, parse_pod_text, validate_pod
 from .models import snapshot as snapshot_mod

@@ -125,7 +125,7 @@ def test_pod_key_metadata_less_pods_never_cross_match():
     """Regression (advisor r2): a victim with neither name nor uid must
     only match by object identity — a ('default','','') key would evict
     every other metadata-less pod on every node."""
-    from cluster_capacity_tpu.framework import _pod_key
+    from cluster_capacity_tpu.engine.preemption import pod_key as _pod_key
     assert _pod_key({}) is None
     assert _pod_key({"metadata": {}}) is None
     assert _pod_key({"metadata": {"namespace": "ns"}}) is None
