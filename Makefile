@@ -7,7 +7,7 @@ CXX ?= g++
 CXXFLAGS ?= -O2 -std=c++17 -fPIC -Wall
 NATIVE_LIB := cluster_capacity_tpu/models/libccsnap.so
 
-.PHONY: all build native test-unit test-parity test-fuzz test-dist test-integration test-e2e bench clean verify-native
+.PHONY: all build native lint test-unit test-parity test-fuzz test-dist test-integration test-e2e bench clean verify-native
 
 all: build
 
@@ -17,6 +17,12 @@ native: $(NATIVE_LIB)
 
 $(NATIVE_LIB): native/ccsnap.cpp
 	$(CXX) $(CXXFLAGS) -shared -o $@ $<
+
+# Format/boilerplate gate (reference: make verify-gofmt + golangci-lint +
+# verify-boilerplate.sh, /root/reference/Makefile:41,54-66).  Self-contained:
+# the image ships no Python linter.
+lint:
+	$(PY) tools/lint.py
 
 # Unit + behavioral suite (fake in-memory clusters; no hardware needed).
 test-unit:

@@ -204,7 +204,7 @@ def run(argv: Optional[List[str]] = None, prog: str = "cluster-capacity") -> int
         # multi-template run against one snapshot: independent batched
         # what-if sweep, or --interleave for shared-state queue semantics
         from ..models.snapshot import ClusterSnapshot
-        from ..parallel.sweep import sweep, sweep_interleaved
+        from ..parallel.sweep import sweep
         from ..utils.report import build_review
         if not args.snapshot:
             raise SystemExit("multi-podspec sweeps require --snapshot")
@@ -222,8 +222,10 @@ def run(argv: Optional[List[str]] = None, prog: str = "cluster-capacity") -> int
         t0 = time.perf_counter()
         with default_tracer.span(SPAN_SOLVE), default_tracer.profile():
             if args.interleave:
-                results = sweep_interleaved(snapshot, pods, profile=profile,
-                                            max_total=args.max_limit)
+                from ..parallel.interleave import sweep_interleaved_auto
+                results = sweep_interleaved_auto(snapshot, pods,
+                                                 profile=profile,
+                                                 max_total=args.max_limit)
             else:
                 results = sweep(snapshot, pods, profile=profile,
                                 max_limit=args.max_limit)

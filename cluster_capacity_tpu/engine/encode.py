@@ -124,7 +124,10 @@ class EncodedProblem:
 
 
 def encode_problem(snapshot: ClusterSnapshot, pod: dict,
-                   profile: SchedulerProfile) -> EncodedProblem:
+                   profile: SchedulerProfile,
+                   ipa_extra_keys=()) -> EncodedProblem:
+    """ipa_extra_keys: extra InterPodAffinity topology-key group rows (see
+    ops/inter_pod_affinity.encode) for the tensor interleave engine."""
     n = snapshot.num_nodes
 
     # --- pod request vectors ------------------------------------------------
@@ -335,7 +338,8 @@ def encode_problem(snapshot: ClusterSnapshot, pod: dict,
         ipa = inter_pod_affinity.encode(
             snapshot, pod,
             ignore_preferred_terms_of_existing_pods=
-            profile.ignore_preferred_terms_of_existing_pods)
+            profile.ignore_preferred_terms_of_existing_pods,
+            extra_topology_keys=ipa_extra_keys)
     else:
         ipa = inter_pod_affinity.encode(
             snapshot, {"metadata": pod.get("metadata", {}), "spec": {}})

@@ -340,9 +340,16 @@ def _row_add(arr: "jax.Array", idx: "jax.Array", delta: "jax.Array") -> "jax.Arr
     return jax.lax.dynamic_update_slice_in_dim(arr, row + delta, idx, axis=0)
 
 
-def _feasibility(cfg: StaticConfig, consts, carry: Carry):
+def _feasibility(cfg: StaticConfig, consts, carry: Carry, eanti_dyn=None):
     """All filter masks for the current state.  Returns (feasible, parts dict
-    for diagnosis)."""
+    for diagnosis).
+
+    eanti_dyn overrides the dynamic existing-pods-anti-affinity counts.  In a
+    single-template solve the placed clones are identical, so 'pods matching
+    my anti terms' and 'pods whose anti terms match me' coincide and both
+    read carry.anti_cnt; the tensor interleave engine carries them
+    separately (another template's clone can have anti terms this template's
+    own selector never matches)."""
     feasible = consts["static_mask"]
     parts = {}
 
@@ -390,7 +397,8 @@ def _feasibility(cfg: StaticConfig, consts, carry: Carry):
         ok, f_aff, f_anti, f_eanti = ipa_ops.filter_all(
             consts["ipa_aff_scnt"] + carry.aff_cnt,
             consts["ipa_anti_scnt"] + carry.anti_cnt,
-            carry.anti_cnt, consts["ipa_dom"],
+            carry.anti_cnt if eanti_dyn is None else eanti_dyn,
+            consts["ipa_dom"],
             consts["ipa_ghas_aff"], consts["ipa_ghas_anti"],
             cfg.ipa_num_aff, cfg.ipa_num_anti, map_empty,
             cfg.ipa_escape_allowed, consts["ipa_eanti_static"])
@@ -468,6 +476,31 @@ def _scores(cfg: StaticConfig, consts, carry: Carry, feasible):
     return total
 
 
+def _sample_scorable(cfg: StaticConfig, feasible, next_start):
+    """Deterministic emulation of findNodesThatPassFilters' truncation
+    (schedule_one.go:610-694): take the first K feasible nodes in
+    round-robin order from the rotating start index, and advance the
+    index past the last node examined.  The K-th feasible node's rank
+    comes from a rotation + prefix sum — no per-step sort.  Shared by the
+    scan step and the tensor interleave engine (parallel/interleave.py)."""
+    import jax
+    import jax.numpy as jnp
+    if cfg.sample_k <= 0:
+        return feasible, next_start
+    n = feasible.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    rank = jnp.remainder(idx - next_start, n)
+    rot = jax.lax.dynamic_slice_in_dim(
+        jnp.concatenate([feasible, feasible]), next_start, n)
+    csum = jnp.cumsum(rot.astype(jnp.int32))
+    reached = csum >= min(cfg.sample_k, n)
+    threshold = jnp.where(jnp.any(reached),
+                          jnp.argmax(reached).astype(jnp.int32), n - 1)
+    scorable = feasible & (rank <= threshold)
+    processed = threshold + 1
+    return scorable, jnp.remainder(next_start + processed, n)
+
+
 def _step(cfg: StaticConfig, consts, carry: Carry):
     import jax
     import jax.numpy as jnp
@@ -476,26 +509,7 @@ def _step(cfg: StaticConfig, consts, carry: Carry):
     feasible, _parts = _feasibility(cfg, consts, carry)
     any_feasible = jnp.any(feasible)
 
-    next_start = carry.next_start
-    scorable = feasible
-    if cfg.sample_k > 0:
-        # Deterministic emulation of findNodesThatPassFilters' truncation
-        # (schedule_one.go:610-694): take the first K feasible nodes in
-        # round-robin order from the rotating start index, and advance the
-        # index past the last node examined.  The K-th feasible node's rank
-        # comes from a rotation + prefix sum — no per-step sort.
-        n = feasible.shape[0]
-        idx = jnp.arange(n, dtype=jnp.int32)
-        rank = jnp.remainder(idx - carry.next_start, n)
-        rot = jax.lax.dynamic_slice_in_dim(
-            jnp.concatenate([feasible, feasible]), carry.next_start, n)
-        csum = jnp.cumsum(rot.astype(jnp.int32))
-        reached = csum >= min(cfg.sample_k, n)
-        threshold = jnp.where(jnp.any(reached),
-                              jnp.argmax(reached).astype(jnp.int32), n - 1)
-        scorable = feasible & (rank <= threshold)
-        processed = threshold + 1
-        next_start = jnp.remainder(carry.next_start + processed, n)
+    scorable, next_start = _sample_scorable(cfg, feasible, carry.next_start)
 
     total = _scores(cfg, consts, carry, scorable)
 
@@ -813,12 +827,12 @@ def solve(pb: enc.EncodedProblem, max_limit: int = 0,
 
 
 def diagnose(pb: enc.EncodedProblem, cfg: StaticConfig, consts,
-             carry: Carry) -> Dict[str, int]:
+             carry: Carry, eanti_dyn=None) -> Dict[str, int]:
     """Per-reason node counts at the stopping state — the tensor equivalent of
     the FitError reasons histogram (types.go:787-828).  Each infeasible node
     contributes the reason(s) of its first failing plugin in filter order; the
     fit plugin contributes every insufficient resource (fit.go:564-660)."""
-    feasible, parts = _feasibility(cfg, consts, carry)
+    feasible, parts = _feasibility(cfg, consts, carry, eanti_dyn=eanti_dyn)
     n = pb.snapshot.num_nodes
     static_code = np.asarray(pb.static_code)
 

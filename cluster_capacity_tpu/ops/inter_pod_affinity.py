@@ -21,6 +21,7 @@ topologyKey) are preserved exactly.
 
 from __future__ import annotations
 
+import dataclasses
 from dataclasses import dataclass
 from typing import Dict, List, Mapping, Optional, Sequence, Tuple
 
@@ -109,6 +110,14 @@ class AffinityEncoding:
     self_pref_match: np.ndarray  # bool[Tp]
     static_pref_score: np.ndarray  # f64[N] — existing-pod contributions
     has_any_score_terms: bool    # static_pref nonzero or dynamic terms exist
+    # --- raw material for cross-template increment matrices -------------
+    # (the tensor interleave engine asks: when template t's clone lands,
+    # how do template u's carried counts change?)
+    owner_ns: str = "default"
+    raw_aff_terms: List = dataclasses.field(default_factory=list)
+    raw_anti_terms: List = dataclasses.field(default_factory=list)
+    raw_soft_terms: List = dataclasses.field(default_factory=list)  # (term, w)
+    has_affinity_field: bool = False
 
     @property
     def active(self) -> bool:
@@ -119,8 +128,13 @@ class AffinityEncoding:
 
 
 def encode(snapshot: ClusterSnapshot, pod: Mapping,
-           ignore_preferred_terms_of_existing_pods: bool = False
+           ignore_preferred_terms_of_existing_pods: bool = False,
+           extra_topology_keys: Sequence[str] = ()
            ) -> AffinityEncoding:
+    """extra_topology_keys adds group rows (with real per-node domains) for
+    topology keys beyond this pod's own terms — the interleave engine needs
+    them so OTHER templates' term contributions (whose keys this pod never
+    uses) have a row to land in."""
     n = snapshot.num_nodes
     meta = pod.get("metadata") or {}
     owner_ns = meta.get("namespace") or "default"
@@ -159,6 +173,8 @@ def encode(snapshot: ClusterSnapshot, pod: Mapping,
                   for t in aff_terms]
     pref_group = np.asarray([group_of(t.get("topologyKey", ""))
                              for t, _, _ in pref_terms], dtype=np.int32)
+    for k in extra_topology_keys:
+        group_of(k)              # appended AFTER own terms: indices stable
 
     g = max(len(keys), 1)
     # Domain vocab per group.
@@ -288,6 +304,11 @@ def encode(snapshot: ClusterSnapshot, pod: Mapping,
         self_pref_match=self_pref,
         static_pref_score=static_pref,
         has_any_score_terms=bool(pref_terms) or bool(pair_scores),
+        owner_ns=owner_ns,
+        raw_aff_terms=list(aff_terms),
+        raw_anti_terms=list(anti_terms),
+        raw_soft_terms=list(soft_terms),
+        has_affinity_field=bool((pod.get("spec") or {}).get("affinity")),
     )
 
 
@@ -346,6 +367,11 @@ def pad_groups(enc_: AffinityEncoding, g_rows: int) -> AffinityEncoding:
         self_pref_match=enc_.self_pref_match,
         static_pref_score=enc_.static_pref_score,
         has_any_score_terms=enc_.has_any_score_terms,
+        owner_ns=enc_.owner_ns,
+        raw_aff_terms=list(enc_.raw_aff_terms),
+        raw_anti_terms=list(enc_.raw_anti_terms),
+        raw_soft_terms=list(enc_.raw_soft_terms),
+        has_affinity_field=enc_.has_affinity_field,
     )
 
 

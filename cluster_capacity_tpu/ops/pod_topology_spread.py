@@ -23,7 +23,7 @@ counting eligibility.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import List, Mapping, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
@@ -60,6 +60,11 @@ class SpreadConstraintSet:
     domain_valid: np.ndarray      # bool[C, D] — domain exists among countable nodes
     init_counts: np.ndarray       # f64[C, D] — existing matching pods per domain
     node_existing: np.ndarray     # f64[C, N] — matching pods on the node itself
+    # raw per-constraint labelSelectors + the owner namespace: the tensor
+    # interleave engine derives cross-template increment matrices from them
+    # (does template t's clone count under template u's constraint c?)
+    selectors: List = field(default_factory=list)
+    namespace: str = "default"
 
     @property
     def empty(self) -> bool:
@@ -242,6 +247,8 @@ def _encode(snapshot: ClusterSnapshot, pod: Mapping,
         domain_valid=domain_valid,
         init_counts=init_counts,
         node_existing=node_existing,
+        selectors=[c.get("labelSelector") for c in constraints],
+        namespace=namespace,
     )
 
 
@@ -388,6 +395,8 @@ def pad_constraints(spread: SpreadConstraintSet, c_rows: int
         init_counts=np.concatenate([spread.init_counts,
                                     np.zeros((pad, d))]),
         node_existing=np.concatenate([spread.node_existing, rows(0.0, np.float64)]),
+        selectors=list(spread.selectors),
+        namespace=spread.namespace,
     )
 
 

@@ -1,0 +1,650 @@
+"""Tensor-speed interleaved queue engine: many templates racing through ONE
+shared cluster state, on device.
+
+`sweep.sweep_interleaved` is the object-level parity path for multi-template
+queue studies (backend/queue/scheduling_queue.go pop semantics): it walks
+Python lists per cycle, so a 100-template x 10k-node study is
+O(T*P*N*plugins) interpreter work.  This module runs the SAME queue
+semantics as a jitted scan: per-template constraint state is carried as
+stacked per-node count tensors ([T, C, N]), and the effect of template t's
+placement on template u's counts is a STATIC cross-template increment
+matrix (does t's clone match u's selector?) computed once at encode time —
+so each queue pop is pure elementwise/reduction work on device.
+
+Scope (everything else falls back to the object path, which stays the
+differential oracle for this engine — tests/test_interleave_tensor.py):
+
+- deterministic profiles without extenders (extender webhooks are
+  host-synchronous by nature);
+- preemption must be structurally impossible: equal template priorities and
+  no existing pod below them (then DefaultPreemption can never produce a
+  victim, and the object path's preemption machinery is dead weight);
+- templates must share one jit specialization (sweep._group_key) and the
+  snapshot resource vocabulary; clone self-conflict gates (host ports,
+  inline disks, RWOP, shared DRA claims) stay on the object path.
+
+Queue semantics mirrored exactly (differentially tested):
+- round-robin pops among active templates in arrival order (equal
+  priorities → FIFO by sequence number; each placement re-enqueues the
+  template's next clone at the tail);
+- an Unschedulable pop halts the chunk; the host diagnoses it with the
+  shared state AT THAT MOMENT (same FitError histogram machinery as
+  single-template solves) and deactivates the template;
+- a parked template whose failure was affinity/spread-shaped re-enters the
+  queue at the next placement (the pod-ADD QueueingHints analog in
+  sweep_interleaved), implemented in-step so the requeue ordering matches
+  the object path placement-for-placement.
+
+Reference: the queue pop loop is the scheduler's core
+(vendor/.../backend/queue/scheduling_queue.go:94-134); one scheduling cycle
+per pop (schedule_one.go:66-150).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Dict, List, NamedTuple, Optional, Sequence
+
+import numpy as np
+
+from ..engine import encode as enc
+from ..engine import simulator as sim
+from ..models import podspec as ps
+from ..models.snapshot import ClusterSnapshot
+from ..ops import inter_pod_affinity as ipa_ops
+from ..ops import pod_topology_spread as spread_ops
+from ..utils.config import SchedulerProfile
+
+# total per-template-tensor elements (T*C*N summed over the ~7 stacked count
+# tensors) the engine will put on device before falling back
+MAX_ELEMS = int(os.environ.get("CC_TPU_INTERLEAVE_ELEMS", str(2 ** 26)))
+CHUNK = 256
+
+
+class XCarry(NamedTuple):
+    """Shared cluster state + per-template views, all on device."""
+
+    requested: "jax.Array"        # f[N, R]   shared
+    nonzero: "jax.Array"          # f[N, 2]   shared
+    placed: "jax.Array"           # i32[N]    shared (all clones)
+    sh_cnt: "jax.Array"           # f[T, Ch, N]
+    ss_cnt: "jax.Array"           # f[T, Cs, N]
+    ssh_cnt: "jax.Array"          # f[T, Cs, N] hostname-row clone counts
+    aff_cnt: "jax.Array"          # f[T, G, N]
+    anti_cnt: "jax.Array"         # f[T, G, N]  pods matching u's anti terms
+    eanti_cnt: "jax.Array"        # f[T, G, N]  clones whose anti terms match u
+    pref_cnt: "jax.Array"         # f[T, G, N]
+    aff_total: "jax.Array"        # f[T]
+    k: "jax.Array"                # i32[T] live per-template placed count
+    active: "jax.Array"           # bool[T]
+    parked_curable: "jax.Array"   # bool[T] — reactivate on next pod-ADD
+    last_seq: "jax.Array"         # i32[T] queue order (min pops first)
+    next_start: "jax.Array"       # i32[T] sampling rotation per template
+    seq_next: "jax.Array"         # i32 next queue sequence number
+    quota: "jax.Array"            # i32 placements remaining (max_total)
+    halt: "jax.Array"             # bool — a pop found no feasible node
+    halt_ti: "jax.Array"          # i32 — which template halted
+
+
+# --------------------------------------------------------------------------
+# cross-template increment matrices (host, numpy, once per run)
+# --------------------------------------------------------------------------
+
+def _clone_matches_selector(clone: dict, sel, ns: str) -> bool:
+    """countPodsMatchSelector semantics for one clone (same namespace +
+    label match; clones are never terminating)."""
+    meta = clone.get("metadata") or {}
+    if (meta.get("namespace") or "default") != ns:
+        return False
+    from ..models.labels import match_label_selector
+    return match_label_selector(sel, meta.get("labels") or {})
+
+
+def _spread_xinc(pbs, which: str) -> np.ndarray:
+    """xinc[t, u, c]: does template t's clone count under template u's
+    constraint row c?  Padded rows stay 0 (inert)."""
+    t_n = len(pbs)
+    sets = [getattr(pb, which) for pb in pbs]
+    c_rows = sets[0].node_domain.shape[0]
+    out = np.zeros((t_n, t_n, c_rows))
+    clones = [ps.make_clone(pb.pod, 0) for pb in pbs]
+    for u, su in enumerate(sets):
+        for c, sel in enumerate(su.selectors):
+            for t in range(t_n):
+                out[t, u, c] = float(_clone_matches_selector(
+                    clones[t], sel, su.namespace))
+    return out
+
+
+def _ipa_xinc(pbs) -> Dict[str, np.ndarray]:
+    """Cross matrices for the four carried IPA tensors, [T, T, G] each,
+    [t_placing, u_observing, group-of-u].  Diagonals are overwritten with
+    group_fold's self increments so a tensor run whose placements happen to
+    be single-template is bit-identical to the single-template engine."""
+    t_n = len(pbs)
+    encs = [pb.ipa for pb in pbs]
+    g_rows = encs[0].node_domain.shape[0]
+    ns_labels = ipa_ops._ns_labels_map(pbs[0].snapshot)
+    clones = [ps.make_clone(pb.pod, 0) for pb in pbs]
+    ignore = pbs[0].profile.ignore_preferred_terms_of_existing_pods
+
+    aff = np.zeros((t_n, t_n, g_rows))
+    anti = np.zeros((t_n, t_n, g_rows))
+    eanti = np.zeros((t_n, t_n, g_rows))
+    pref = np.zeros((t_n, t_n, g_rows))
+
+    def group_row(e_u, key: str) -> Optional[int]:
+        try:
+            return e_u.group_keys.index(key)
+        except ValueError:
+            return None
+
+    for u, e_u in enumerate(encs):
+        u_soft = bool(e_u.raw_soft_terms)
+        for t in range(t_n):
+            e_t = encs[t]
+            clone_t = clones[t]
+            # u's own required terms vs t's clone → aff/anti counts
+            for terms, groups, mat in (
+                    (e_u.raw_aff_terms, e_u.aff_group, aff),
+                    (e_u.raw_anti_terms, e_u.anti_group, anti)):
+                for idx, term in enumerate(terms):
+                    if ipa_ops._term_matches_pod(term, e_u.owner_ns, clone_t,
+                                                 ns_labels):
+                        mat[t, u, int(groups[idx])] += 1.0
+            # t's clone's required ANTI terms vs u's pod → eanti counts
+            for term in e_t.raw_anti_terms:
+                if ipa_ops._term_matches_pod(term, e_t.owner_ns, pbs[u].pod,
+                                             ns_labels):
+                    g = group_row(e_u, term.get("topologyKey", ""))
+                    if g is not None:
+                        eanti[t, u, g] += 1.0
+            # preferred scoring, processExistingPod (scoring.go:81-125):
+            # (a) u's soft terms vs the existing clone of t
+            for term, w in e_u.raw_soft_terms:
+                if ipa_ops._term_matches_pod(term, e_u.owner_ns, clone_t,
+                                             ns_labels):
+                    g = group_row(e_u, term.get("topologyKey", ""))
+                    if g is not None:
+                        pref[t, u, g] += w
+            # (b) the clone's terms vs u's incoming pod (scoring.go:144-160)
+            if (e_t.has_affinity_field or u_soft) and not (
+                    ignore and not u_soft):
+                for term in e_t.raw_aff_terms:
+                    if ipa_ops._term_matches_pod(term, e_t.owner_ns,
+                                                 pbs[u].pod, ns_labels):
+                        g = group_row(e_u, term.get("topologyKey", ""))
+                        if g is not None:
+                            pref[t, u, g] += ipa_ops.HARD_POD_AFFINITY_WEIGHT
+                for term, w in e_t.raw_soft_terms:
+                    if ipa_ops._term_matches_pod(term, e_t.owner_ns,
+                                                 pbs[u].pod, ns_labels):
+                        g = group_row(e_u, term.get("topologyKey", ""))
+                        if g is not None:
+                            pref[t, u, g] += w
+    for t, e_t in enumerate(encs):
+        _gaff, _ganti, aff_ginc, anti_ginc, pref_gw = ipa_ops.group_fold(e_t)
+        aff[t, t, :] = aff_ginc
+        anti[t, t, :] = anti_ginc
+        eanti[t, t, :] = anti_ginc      # identical clones: the two anti
+        pref[t, t, :] = pref_gw         # directions coincide (simulator.py)
+    return {"aff_xinc": aff, "anti_xinc": anti, "eanti_xinc": eanti,
+            "pref_xinc": pref}
+
+
+def union_topology_keys(templates: Sequence[dict]) -> List[str]:
+    """Every topologyKey used by any template's affinity terms — the extra
+    group rows each template's encoding needs so cross contributions from
+    other templates' terms have a row to land in."""
+    keys: List[str] = []
+
+    def add(term):
+        k = (term or {}).get("topologyKey", "")
+        if k and k not in keys:
+            keys.append(k)
+
+    for t in templates:
+        for kind in ("podAffinity", "podAntiAffinity"):
+            for term in ipa_ops._required_terms(t, kind):
+                add(term)
+            for wt in ipa_ops._preferred_terms(t, kind):
+                add(wt.get("podAffinityTerm"))
+    return keys
+
+
+# --------------------------------------------------------------------------
+# eligibility
+# --------------------------------------------------------------------------
+
+def _preemption_impossible(snapshot: ClusterSnapshot,
+                           templates: Sequence[dict]) -> bool:
+    """True when DefaultPreemption can never find a victim: all templates
+    share one priority and every existing pod is at or above it (victims
+    must be STRICTLY lower than the preemptor, preemption.go:200-205)."""
+    from ..engine.preemption import resolve_priority
+    prios = {resolve_priority(t, snapshot.priority_classes)
+             for t in templates}
+    if len(prios) > 1:
+        return False
+    p = prios.pop() if prios else 0
+    for plist in snapshot.pods_by_node:
+        for pod in plist:
+            if resolve_priority(pod, snapshot.priority_classes) < p:
+                return False
+    return True
+
+
+def eligible(snapshot: ClusterSnapshot, templates: Sequence[dict],
+             profile: SchedulerProfile, pbs) -> Optional[str]:
+    """None when the tensor engine can run this study; otherwise the reason
+    for the object-path fallback."""
+    from . import sweep as sweep_mod
+
+    if not profile.deterministic:
+        return "non-deterministic tie-break"
+    if profile.extenders:
+        return "extenders are host-synchronous"
+    if profile.include_preemption_message:
+        return "preemption message formatting needs the object path"
+    if "DefaultPreemption" in profile.post_filters and \
+            not _preemption_impossible(snapshot, templates):
+        return "preemption pressure (priorities differ)"
+    solvable = [pb for pb in pbs
+                if pb.pod_level_reason is None
+                and not (pb.pod.get("spec") or {}).get("schedulingGates")]
+    if not solvable:
+        return None                     # nothing to tensor-solve; trivial
+    rn = solvable[0].resource_names
+    for pb in solvable:
+        if not sweep_mod._batchable(pb) or pb.clone_has_host_ports:
+            return "clone self-conflict gates (ports/volumes/DRA)"
+        if pb.resource_names != rn:
+            return "templates disagree on the resource vocabulary"
+    # _group_key keeps the lonely-pod escape statics in the key so batched
+    # sweeps never merge aff-templates with different flags; here the group
+    # must contain EVERY template, so normalize them out of the key and
+    # check the aff-templates agree separately (_pad_group's any() merge is
+    # only sound when they do).
+    keys = set()
+    aff_flags = set()
+    for pb in solvable:
+        cfg = sim.static_config(pb)
+        if cfg.ipa_num_aff:
+            aff_flags.add((cfg.ipa_escape_allowed, cfg.ipa_static_empty))
+        k = sweep_mod._group_key(pb, cfg)
+        keys.add((k[0]._replace(ipa_escape_allowed=False,
+                                ipa_static_empty=False),) + tuple(k[1:]))
+    if len(keys) > 1:
+        return "templates need different jit specializations"
+    if len(aff_flags) > 1:
+        return "affinity templates disagree on lonely-pod escape statics"
+    t_n = len(solvable)
+    n = snapshot.num_nodes
+    padded_c = max(pb.spread_hard.node_domain.shape[0] for pb in solvable) \
+        + max(pb.spread_soft.node_domain.shape[0] for pb in solvable) * 2 \
+        + max(pb.ipa.node_domain.shape[0] for pb in solvable) * 4
+    if t_n * padded_c * n > MAX_ELEMS:
+        return "per-template state exceeds the device budget"
+    return None
+
+
+# --------------------------------------------------------------------------
+# the jitted step
+# --------------------------------------------------------------------------
+
+def _idx(a, t):
+    import jax
+    return jax.lax.dynamic_index_in_dim(a, t, 0, keepdims=False)
+
+
+def _col3(a, chosen):
+    """a[:, :, chosen] via dynamic slice."""
+    import jax
+    return jax.lax.dynamic_slice_in_dim(a, chosen, 1, axis=2)[:, :, 0]
+
+
+def _xstep(cfg: sim.StaticConfig, sconsts, xconsts, xc: XCarry):
+    import jax
+    import jax.numpy as jnp
+    dt = sim._dt(cfg)
+    t_n = xc.k.shape[0]
+
+    inf = jnp.asarray(2 ** 30, jnp.int32)
+    t = jnp.argmin(jnp.where(xc.active, xc.last_seq, inf)).astype(jnp.int32)
+    any_active = jnp.any(xc.active)
+    live = any_active & ~xc.halt & (xc.quota > 0)
+
+    c_t = {k: _idx(v, t) for k, v in sconsts.items()}
+    # hostname soft-spread counts ride the consts view: scoring reads
+    # hostname_cnt = ss_node_existing + ss_self*placed; cross-template
+    # clone counts replace the self term (simulator._scores)
+    c_t["ss_node_existing"] = c_t["ss_node_existing"] + _idx(xc.ssh_cnt, t)
+    c_t["ss_self"] = jnp.zeros_like(c_t["ss_self"])
+
+    view = sim.Carry(
+        requested=xc.requested, nonzero=xc.nonzero, placed=xc.placed,
+        sh_cnt=_idx(xc.sh_cnt, t), ss_cnt=_idx(xc.ss_cnt, t),
+        aff_cnt=_idx(xc.aff_cnt, t), anti_cnt=_idx(xc.anti_cnt, t),
+        pref_cnt=_idx(xc.pref_cnt, t), aff_total=xc.aff_total[t],
+        placed_count=xc.k[t], stopped=~live, next_start=xc.next_start[t],
+        rng=jax.random.PRNGKey(0))
+
+    feasible, parts = sim._feasibility(cfg, c_t, view,
+                                       eanti_dyn=_idx(xc.eanti_cnt, t))
+    any_feasible = jnp.any(feasible)
+    scorable, new_ns = sim._sample_scorable(cfg, feasible, xc.next_start[t])
+    total = sim._scores(cfg, c_t, view, scorable)
+    keyed = jnp.where(scorable, total, jnp.asarray(-1.0, dt))
+    chosen = jnp.argmax(keyed).astype(jnp.int32)
+
+    do = live & any_feasible
+    fails = live & ~any_feasible
+    # Device-side curability (mirrors diagnose()'s first-fail attribution):
+    # a failure is pod-ADD-curable when SOME node's first failing class is
+    # one another pod can change — static port conflicts, spread, or
+    # inter-pod affinity.  Curable failures re-park IN-STEP (the template
+    # re-enters the queue at the next placement; its final diagnosis is
+    # computed once at the end, when its last re-park state IS the end
+    # state); non-curable failures — including a curable template whose
+    # failure just degraded to Insufficient-cpu — halt the chunk so the
+    # host can diagnose with the state at exactly this moment.
+    n_nodes = feasible.shape[0]
+    fit_ok = parts["fit"].mask if "fit" in parts \
+        else jnp.ones(n_nodes, dtype=bool)
+    sm = parts.get("spread_missing", jnp.zeros(n_nodes, dtype=bool))
+    s_ok = parts.get("spread_ok", jnp.ones(n_nodes, dtype=bool))
+    if "ipa" in parts:
+        f_aff, f_anti, f_eanti = parts["ipa"]
+        ipa_fail = f_aff | f_anti | f_eanti
+    else:
+        ipa_fail = jnp.zeros(n_nodes, dtype=bool)
+    base_ok = c_t["static_mask"] & fit_ok & c_t["volume_mask"]
+    curable_node = _idx(xconsts["static_ports_fail"], t) | \
+        (base_ok & (sm | ~s_ok | ipa_fail))
+    curable_now = jnp.any(curable_node)
+    repark = fails & curable_now
+    halts = fails & ~curable_now
+    gate = do.astype(dt)
+    onehot_t = jnp.arange(t_n, dtype=jnp.int32) == t
+
+    requested = sim._row_add(xc.requested, chosen,
+                             (gate * c_t["req_vec"])[None, :])
+    nonzero = sim._row_add(xc.nonzero, chosen,
+                           (gate * c_t["req_nonzero"])[None, :])
+    placed = sim._row_add(xc.placed, chosen, do.astype(jnp.int32).reshape(1))
+
+    sh_cnt, ss_cnt, ssh_cnt = xc.sh_cnt, xc.ss_cnt, xc.ssh_cnt
+    if cfg.spread_hard_n > 0:
+        xrow = _idx(xconsts["sh_xinc"], t)                     # [T, Ch]
+        dom_ch = _col3(sconsts["sh_dom"], chosen)
+        inc = xrow * _col3(sconsts["sh_countable"], chosen).astype(dt) * gate
+        hit = (sconsts["sh_dom"] == dom_ch[:, :, None]) & \
+            (sconsts["sh_dom"] >= 0)
+        sh_cnt = xc.sh_cnt + hit.astype(dt) * inc[:, :, None]
+    if cfg.spread_soft_n > 0:
+        xrow = _idx(xconsts["ss_xinc"], t)                     # [T, Cs]
+        dom_ch = _col3(sconsts["ss_dom"], chosen)
+        inc = xrow * _col3(sconsts["ss_countable"], chosen).astype(dt) * gate
+        hit = (sconsts["ss_dom"] == dom_ch[:, :, None]) & \
+            (sconsts["ss_dom"] >= 0)
+        ss_cnt = xc.ss_cnt + hit.astype(dt) * inc[:, :, None]
+        # hostname rows: matching-clones-on-the-node counts, ungated by the
+        # inclusion policy (hostname_cnt parity with simulator._scores)
+        n = xc.placed.shape[0]
+        node_onehot = (jnp.arange(n, dtype=jnp.int32) == chosen).astype(dt)
+        inc_h = xrow * sconsts["ss_host"].astype(dt) * gate    # [T, Cs]
+        ssh_cnt = xc.ssh_cnt + inc_h[:, :, None] * node_onehot[None, None, :]
+
+    aff_cnt, anti_cnt, eanti_cnt, pref_cnt = \
+        xc.aff_cnt, xc.anti_cnt, xc.eanti_cnt, xc.pref_cnt
+    aff_total = xc.aff_total
+    if cfg.ipa_num_aff > 0 or cfg.ipa_num_anti > 0 or cfg.ipa_num_pref > 0 \
+            or cfg.ipa_filter_on or cfg.ipa_score_active:
+        dom_ch = _col3(sconsts["ipa_dom"], chosen)             # [T, G]
+        valid = (dom_ch >= 0).astype(dt)
+        hit = ((sconsts["ipa_dom"] == dom_ch[:, :, None]) &
+               (sconsts["ipa_dom"] >= 0)).astype(dt)
+
+        def upd(cnt, key):
+            inc = _idx(xconsts[key], t) * valid * gate
+            return cnt + hit * inc[:, :, None], inc
+
+        aff_cnt, aff_inc = upd(xc.aff_cnt, "aff_xinc")
+        anti_cnt, _ = upd(xc.anti_cnt, "anti_xinc")
+        eanti_cnt, _ = upd(xc.eanti_cnt, "eanti_xinc")
+        pref_cnt, _ = upd(xc.pref_cnt, "pref_xinc")
+        aff_total = xc.aff_total + jnp.sum(aff_inc, axis=1)
+
+    # queue bookkeeping: the placement is a pod-ADD event — parked-curable
+    # templates re-enter the queue BEFORE the placer's next clone (the
+    # object path requeues, then re-pushes the placer)
+    reactivate = xc.parked_curable & do
+    active = (xc.active | reactivate) & ~(onehot_t & repark)
+    parked_curable = (xc.parked_curable & ~reactivate) | (onehot_t & repark)
+    last_seq = jnp.where(reactivate, xc.seq_next, xc.last_seq)
+    last_seq = jnp.where(onehot_t & do, xc.seq_next + 1, last_seq)
+    seq_next = xc.seq_next + 2 * do.astype(jnp.int32)
+    k = xc.k + (onehot_t & do).astype(jnp.int32)
+    next_start = jnp.where(onehot_t & do, new_ns, xc.next_start)
+
+    out = XCarry(
+        requested=requested, nonzero=nonzero, placed=placed,
+        sh_cnt=sh_cnt, ss_cnt=ss_cnt, ssh_cnt=ssh_cnt,
+        aff_cnt=aff_cnt, anti_cnt=anti_cnt, eanti_cnt=eanti_cnt,
+        pref_cnt=pref_cnt, aff_total=aff_total,
+        k=k, active=active, parked_curable=parked_curable,
+        last_seq=last_seq, next_start=next_start, seq_next=seq_next,
+        quota=xc.quota - do.astype(jnp.int32),
+        halt=xc.halt | halts,
+        halt_ti=jnp.where(halts, t, xc.halt_ti))
+    emit_t = jnp.where(do, t, -1)
+    return out, (emit_t, jnp.where(do, chosen, -1))
+
+
+@functools.lru_cache(maxsize=None)
+def _xchunk_runner():
+    import jax
+
+    @functools.partial(jax.jit, static_argnames=("cfg", "length"))
+    def run(cfg, sconsts, xconsts, xc, length: int):
+        def body(c, _):
+            return _xstep(cfg, sconsts, xconsts, c)
+        return jax.lax.scan(body, xc, None, length=length)
+
+    return run
+
+
+# --------------------------------------------------------------------------
+# the host loop
+# --------------------------------------------------------------------------
+
+def solve_interleaved_tensor(snapshot: ClusterSnapshot,
+                             templates: Sequence[dict],
+                             profile: Optional[SchedulerProfile] = None,
+                             max_total: int = 0
+                             ) -> Optional[List[sim.SolveResult]]:
+    """Run the interleaved study on device; None when ineligible (callers
+    fall back to sweep.sweep_interleaved, the object-level parity path)."""
+    import jax
+    import jax.numpy as jnp
+
+    from . import sweep as sweep_mod
+    from ..ops import volumes as vol_ops
+
+    profile = profile or SchedulerProfile()
+    templates = list(templates)
+    n = snapshot.num_nodes
+    if n == 0 or not templates:
+        return None
+
+    sim._ensure_x64(profile)
+    extra_keys = union_topology_keys(templates)
+    pbs_all = [enc.encode_problem(snapshot, t, profile,
+                                  ipa_extra_keys=extra_keys)
+               for t in templates]
+    reason = eligible(snapshot, templates, profile, pbs_all)
+    if reason is not None:
+        return None
+
+    results: List[Optional[sim.SolveResult]] = [None] * len(templates)
+    solve_idx: List[int] = []
+    for i, pb in enumerate(pbs_all):
+        if (pb.pod.get("spec") or {}).get("schedulingGates"):
+            r = enc.REASON_SCHEDULING_GATED
+            results[i] = sim.SolveResult(
+                placements=[], placed_count=0, fail_type="SchedulingGated",
+                fail_message=f"0/{n} nodes are available: {r}.",
+                fail_counts={r: n}, node_names=snapshot.node_names)
+        elif pb.pod_level_reason:
+            results[i] = sim.SolveResult(
+                placements=[], placed_count=0,
+                fail_type=sim.FAIL_UNSCHEDULABLE,
+                fail_message=f"0/{n} nodes are available: "
+                             f"{pb.pod_level_reason}.",
+                fail_counts={pb.pod_level_reason: n},
+                node_names=snapshot.node_names)
+        else:
+            solve_idx.append(i)
+    if not solve_idx:
+        return results  # type: ignore[return-value]
+
+    pbs, cfg, dnh = sweep_mod._pad_group([pbs_all[i] for i in solve_idx])
+    t_n = len(pbs)
+    consts_list = [sim.build_consts(pb, ss_dnh_min=dnh) for pb in pbs]
+    sconsts = {k: jnp.stack([c[k] for c in consts_list])
+               for k in consts_list[0]}
+
+    dt = consts_list[0]["allocatable"].dtype
+    f = lambda a: jnp.asarray(a, dtype=dt)
+    xconsts = {
+        "sh_xinc": f(_spread_xinc(pbs, "spread_hard")),
+        "ss_xinc": f(_spread_xinc(pbs, "spread_soft")),
+        # static port conflicts vs EXISTING pods carry the curable ports
+        # reason string (diagnose attributes static codes first)
+        "static_ports_fail": jnp.stack([
+            jnp.asarray(np.asarray(pb.static_code) == enc.CODE_PORTS)
+            for pb in pbs]),
+        **{k: f(v) for k, v in _ipa_xinc(pbs).items()},
+    }
+
+    g = pbs[0].ipa.node_domain.shape[0]
+    cs = pbs[0].spread_soft.node_domain.shape[0]
+    xc = XCarry(
+        requested=f(pbs[0].init_requested),
+        nonzero=f(pbs[0].init_nonzero),
+        placed=jnp.zeros(n, dtype=jnp.int32),
+        sh_cnt=sconsts["sh_cnt_init"],
+        ss_cnt=sconsts["ss_cnt_init"],
+        ssh_cnt=jnp.zeros((t_n, cs, n), dtype=dt),
+        aff_cnt=jnp.zeros((t_n, g, n), dtype=dt),
+        anti_cnt=jnp.zeros((t_n, g, n), dtype=dt),
+        eanti_cnt=jnp.zeros((t_n, g, n), dtype=dt),
+        pref_cnt=jnp.zeros((t_n, g, n), dtype=dt),
+        aff_total=jnp.zeros(t_n, dtype=dt),
+        k=jnp.zeros(t_n, dtype=jnp.int32),
+        active=jnp.ones(t_n, dtype=bool),
+        parked_curable=jnp.zeros(t_n, dtype=bool),
+        last_seq=jnp.arange(t_n, dtype=jnp.int32),
+        next_start=jnp.zeros(t_n, dtype=jnp.int32),
+        seq_next=jnp.asarray(t_n, jnp.int32),
+        quota=jnp.asarray(0, jnp.int32),
+        halt=jnp.asarray(False),
+        halt_ti=jnp.asarray(0, jnp.int32))
+
+    budget = min(sum(pb.max_steps_hint for pb in pbs) + t_n + 1,
+                 sim._DEFAULT_UNLIMITED_CAP)
+    if max_total:
+        budget = min(budget, max_total)
+    xc = xc._replace(quota=jnp.asarray(budget, jnp.int32))
+
+    def view_of(ti: int):
+        return sim.Carry(
+            requested=xc.requested, nonzero=xc.nonzero, placed=xc.placed,
+            sh_cnt=xc.sh_cnt[ti], ss_cnt=xc.ss_cnt[ti],
+            aff_cnt=xc.aff_cnt[ti], anti_cnt=xc.anti_cnt[ti],
+            pref_cnt=xc.pref_cnt[ti], aff_total=xc.aff_total[ti],
+            placed_count=xc.k[ti], stopped=jnp.asarray(True),
+            next_start=xc.next_start[ti], rng=jax.random.PRNGKey(0))
+
+    def park_result(ti: int):
+        counts = sim.diagnose(pbs[ti], cfg, consts_list[ti], view_of(ti),
+                              eanti_dyn=xc.eanti_cnt[ti])
+        results[solve_idx[ti]] = sim.SolveResult(
+            placements=list(placements[ti]),
+            placed_count=len(placements[ti]),
+            fail_type=sim.FAIL_UNSCHEDULABLE,
+            fail_message=sim.format_fit_error(n, counts),
+            fail_counts=counts, node_names=snapshot.node_names)
+        return counts
+
+    run = _xchunk_runner()
+    placements: List[List[int]] = [[] for _ in pbs]
+    total = 0
+    steps_done = 0
+    # backstop far above any real run: per placement, every curable-parked
+    # template may take one no-op retry pop, and each of the <= t_n halts
+    # no-ops the remainder of its chunk
+    max_steps = (budget + 1) * (t_n + 2) + CHUNK * (t_n + 2)
+
+    while steps_done < max_steps:
+        if not bool(np.asarray(xc.active).any()) or total >= budget:
+            break
+        xc, (ts, chs) = run(cfg, sconsts, xconsts, xc, CHUNK)
+        ts = np.asarray(ts)
+        chs = np.asarray(chs)
+        for t_i, ch_i in zip(ts.tolist(), chs.tolist()):
+            if t_i >= 0:
+                placements[t_i].append(ch_i)
+                total += 1
+        steps_done += CHUNK
+        if bool(np.asarray(xc.halt)):
+            # a NON-curable park: diagnose with the state at exactly this
+            # moment (in-step no-ops preserved it) and retire the template
+            # permanently — no event in scope can requeue it.
+            ti = int(np.asarray(xc.halt_ti))
+            counts = park_result(ti)
+            active_np = np.asarray(xc.active).copy()
+            parked_np = np.asarray(xc.parked_curable).copy()
+            active_np[ti] = False
+            # the device curability test mirrors diagnose(); if they ever
+            # drift, trust the diagnosis (requeue rather than strand)
+            parked_np[ti] = bool(set(counts) &
+                                 sweep_mod._add_curable_reasons())
+            xc = xc._replace(active=jnp.asarray(active_np),
+                             parked_curable=jnp.asarray(parked_np),
+                             halt=jnp.asarray(False))
+
+    # End classification mirrors the object loop's break: templates still
+    # IN the queue get LimitReached; curable-parked ones were last
+    # diagnosed... never — their last in-step re-park state IS this end
+    # state (any later placement would have reactivated them), so diagnose
+    # now.
+    active_end = np.asarray(xc.active)
+    for ti in range(t_n):
+        i = solve_idx[ti]
+        if bool(active_end[ti]):
+            results[i] = sim.SolveResult(
+                placements=list(placements[ti]),
+                placed_count=len(placements[ti]),
+                fail_type=sim.FAIL_LIMIT_REACHED,
+                fail_message=(f"Maximum number of pods simulated: "
+                              f"{max_total or budget}"),
+                node_names=snapshot.node_names)
+        elif results[i] is None:        # in-step curable park
+            park_result(ti)
+    return results  # type: ignore[return-value]
+
+
+def sweep_interleaved_auto(snapshot: ClusterSnapshot,
+                           templates: Sequence[dict],
+                           profile: Optional[SchedulerProfile] = None,
+                           max_total: int = 0) -> List[sim.SolveResult]:
+    """Tensor engine when eligible, object-level queue loop otherwise."""
+    res = solve_interleaved_tensor(snapshot, templates, profile,
+                                   max_total=max_total)
+    if res is not None:
+        return res
+    from .sweep import sweep_interleaved
+    return sweep_interleaved(snapshot, templates, profile,
+                             max_total=max_total)

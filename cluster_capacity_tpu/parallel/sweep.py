@@ -267,6 +267,17 @@ def _batched_solve(pbs: List[enc.EncodedProblem], max_limit: int,
     return results
 
 
+def _add_curable_reasons():
+    """pod-ADD QueueingHints analog: failure classes a new pod can cure.
+    Shared by the object queue loop and the tensor interleave engine."""
+    from ..ops import inter_pod_affinity as ipa_ops
+    from ..ops import node_ports as ports_ops
+    from ..ops import pod_topology_spread as spread_ops
+    return {ipa_ops.REASON_AFFINITY, ipa_ops.REASON_ANTI_AFFINITY,
+            ipa_ops.REASON_EXISTING_ANTI, spread_ops.REASON_CONSTRAINTS,
+            spread_ops.REASON_MISSING_LABEL, ports_ops.REASON}
+
+
 def sweep_interleaved(snapshot: ClusterSnapshot, templates: Sequence[dict],
                       profile: Optional[SchedulerProfile] = None,
                       max_total: int = 0) -> List[sim.SolveResult]:
@@ -314,9 +325,6 @@ def sweep_interleaved(snapshot: ClusterSnapshot, templates: Sequence[dict],
     from ..ops import volumes as vol_ops
 
     from ..models import snapshot as snapshot_mod
-    from ..ops import inter_pod_affinity as ipa_ops
-    from ..ops import node_ports as ports_ops
-    from ..ops import pod_topology_spread as spread_ops
 
     profile = profile or SchedulerProfile()
     n = snapshot.num_nodes
@@ -338,11 +346,7 @@ def sweep_interleaved(snapshot: ClusterSnapshot, templates: Sequence[dict],
     # tiers (the reference can't hit this: it never runs multiple templates)
     preempt_budget = 10 * len(templates) + 100
 
-    # pod-ADD QueueingHints analog: failure classes a new pod can cure
-    _ADD_CURABLE = {ipa_ops.REASON_AFFINITY, ipa_ops.REASON_ANTI_AFFINITY,
-                    ipa_ops.REASON_EXISTING_ANTI,
-                    spread_ops.REASON_CONSTRAINTS,
-                    spread_ops.REASON_MISSING_LABEL, ports_ops.REASON}
+    _ADD_CURABLE = _add_curable_reasons()
 
     heap: List[tuple] = []
     seq = 0

@@ -1,0 +1,288 @@
+"""Tensor interleave engine vs the object-level queue loop (its oracle).
+
+parallel/interleave.py runs the shared-state multi-template queue study on
+device; parallel/sweep.sweep_interleaved is the object-level parity path.
+Every eligible study must match it bit-for-bit: placements, fail types,
+fail messages.  Reference semantics: backend/queue/scheduling_queue.go pop
+loop + one scheduling cycle per pop (schedule_one.go:66-150).
+"""
+
+import numpy as np
+import pytest
+
+from cluster_capacity_tpu.models.podspec import default_pod
+from cluster_capacity_tpu.models.snapshot import ClusterSnapshot
+from cluster_capacity_tpu.parallel import interleave as il
+from cluster_capacity_tpu.parallel.sweep import sweep_interleaved
+from cluster_capacity_tpu.utils.config import SchedulerProfile
+
+
+def _nodes(n, zones=3, cpus=(2000, 4000), pods=16, seed=0):
+    rng = np.random.RandomState(seed)
+    out = []
+    for i in range(n):
+        out.append({
+            "metadata": {"name": f"n{i:03d}", "labels": {
+                "kubernetes.io/hostname": f"n{i:03d}",
+                "topology.kubernetes.io/zone": f"z{i % zones}",
+                "disk": "ssd" if i % 2 else "hdd"}},
+            "spec": {},
+            "status": {"allocatable": {
+                "cpu": f"{int(rng.choice(cpus))}m",
+                "memory": str(int(rng.choice([4, 8])) * 1024 ** 3),
+                "pods": str(pods)}}})
+    return out
+
+
+def _template(name, cpu, mem_gi=0, ns="default", spread=None, soft=None,
+              aff=None, anti=None, pref_anti=None, labels=None):
+    req = {"cpu": f"{cpu}m"}
+    if mem_gi:
+        req["memory"] = f"{mem_gi}Gi"
+    pod = {"metadata": {"name": name, "namespace": ns,
+                        "labels": dict(labels or {"app": name})},
+           "spec": {"containers": [{"name": "c", "resources": {
+               "requests": req}}]}}
+    tsc = []
+    if spread:
+        tsc.append({"maxSkew": spread[0], "topologyKey": spread[1],
+                    "whenUnsatisfiable": "DoNotSchedule",
+                    "labelSelector": {"matchLabels": spread[2]}})
+    if soft:
+        tsc.append({"maxSkew": soft[0], "topologyKey": soft[1],
+                    "whenUnsatisfiable": "ScheduleAnyway",
+                    "labelSelector": {"matchLabels": soft[2]}})
+    if tsc:
+        pod["spec"]["topologySpreadConstraints"] = tsc
+    affinity = {}
+    if aff:
+        affinity["podAffinity"] = {
+            "requiredDuringSchedulingIgnoredDuringExecution": [
+                {"topologyKey": aff[0],
+                 "labelSelector": {"matchLabels": aff[1]}}]}
+    if anti:
+        affinity.setdefault("podAntiAffinity", {})[
+            "requiredDuringSchedulingIgnoredDuringExecution"] = [
+            {"topologyKey": anti[0],
+             "labelSelector": {"matchLabels": anti[1]}}]
+    if pref_anti:
+        affinity.setdefault("podAntiAffinity", {})[
+            "preferredDuringSchedulingIgnoredDuringExecution"] = [
+            {"weight": pref_anti[0], "podAffinityTerm": {
+                "topologyKey": pref_anti[1],
+                "labelSelector": {"matchLabels": pref_anti[2]}}}]
+    if affinity:
+        pod["spec"]["affinity"] = affinity
+    return default_pod(pod)
+
+
+def _assert_same(ref, got, label=""):
+    assert got is not None, f"{label}: tensor path fell back"
+    for i, (r, g) in enumerate(zip(ref, got)):
+        assert r.placements == g.placements, \
+            f"{label}[{i}]: {r.placements} != {g.placements}"
+        assert r.fail_type == g.fail_type, f"{label}[{i}]"
+        assert r.fail_message == g.fail_message, \
+            f"{label}[{i}]: {r.fail_message!r} != {g.fail_message!r}"
+
+
+def test_plain_mix_matches_object_path():
+    snap = ClusterSnapshot.from_objects(_nodes(10))
+    ts = [_template("a", 600), _template("b", 450, mem_gi=1),
+          _template("c", 900)]
+    prof = SchedulerProfile.parity()
+    _assert_same(sweep_interleaved(snap, ts, prof),
+                 il.solve_interleaved_tensor(snap, ts, prof), "plain")
+
+
+def test_topology_mix_matches_object_path():
+    """Spread + IPA cross-template coupling: b's clones count under a's
+    selector (shared app label), anti-affinity blocks across templates."""
+    snap = ClusterSnapshot.from_objects(_nodes(12))
+    shared = {"tier": "web"}
+    ts = [
+        _template("a", 500, spread=(2, "topology.kubernetes.io/zone", shared),
+                  labels={"app": "a", "tier": "web"}),
+        _template("b", 400, labels={"app": "b", "tier": "web"}),
+        _template("c", 300, anti=("kubernetes.io/hostname", {"app": "c"})),
+        _template("d", 350, soft=(1, "topology.kubernetes.io/zone",
+                                  {"app": "d"})),
+    ]
+    prof = SchedulerProfile.parity()
+    _assert_same(sweep_interleaved(snap, ts, prof),
+                 il.solve_interleaved_tensor(snap, ts, prof), "topo")
+
+
+def test_cross_template_affinity_and_add_requeue():
+    """a requires affinity to b's clones: a parks first, b's placements
+    reactivate it (pod-ADD hint) — both engines must agree."""
+    snap = ClusterSnapshot.from_objects(_nodes(9))
+    ts = [
+        _template("a", 400, aff=("topology.kubernetes.io/zone",
+                                 {"app": "b"})),
+        _template("b", 700),
+    ]
+    prof = SchedulerProfile.parity()
+    ref = sweep_interleaved(snap, ts, prof)
+    got = il.solve_interleaved_tensor(snap, ts, prof)
+    _assert_same(ref, got, "aff-requeue")
+    assert ref[0].placed_count > 0          # the requeue actually fired
+
+
+def test_sampling_scale_matches_object_path():
+    """>100 nodes with percentageOfNodesToScore active: the rotating
+    per-template sampling windows must stay in lockstep."""
+    snap = ClusterSnapshot.from_objects(_nodes(130, zones=5, seed=3))
+    ts = [_template("a", 800), _template("b", 650, mem_gi=1)]
+    prof = SchedulerProfile.parity()
+    prof.percentage_of_nodes_to_score = 60
+    _assert_same(sweep_interleaved(snap, ts, prof, max_total=120),
+                 il.solve_interleaved_tensor(snap, ts, prof, max_total=120),
+                 "sampling")
+
+
+def test_max_total_and_gated_templates():
+    snap = ClusterSnapshot.from_objects(_nodes(6))
+    gated = default_pod({"metadata": {"name": "g"}, "spec": {
+        "containers": [{"name": "c", "resources": {
+            "requests": {"cpu": "100m"}}}],
+        "schedulingGates": [{"name": "w"}]}})
+    ts = [gated, _template("a", 500), _template("b", 500)]
+    prof = SchedulerProfile.parity()
+    _assert_same(sweep_interleaved(snap, ts, prof, max_total=7),
+                 il.solve_interleaved_tensor(snap, ts, prof, max_total=7),
+                 "max-total")
+
+
+def test_fuzz_mixed_families():
+    """Randomized differential: template mixes over spread/soft/IPA/plain
+    with namespaces and existing pods."""
+    rng = np.random.RandomState(11)
+    for seed in range(6):
+        n = int(rng.choice([8, 14, 20]))
+        nodes = _nodes(n, zones=int(rng.choice([2, 3])), seed=seed)
+        existing = []
+        for j in range(int(rng.choice([0, 3]))):
+            existing.append({
+                "metadata": {"name": f"pre{j}", "namespace": "default",
+                             "labels": {"tier": "web"}},
+                "spec": {"containers": [{"name": "c", "resources": {
+                    "requests": {"cpu": "300m"}}}],
+                    "nodeName": f"n{j % n:03d}"}})
+        snap = ClusterSnapshot.from_objects(nodes, existing)
+        ts = []
+        for k in range(int(rng.choice([2, 4]))):
+            kind = rng.choice(["plain", "spread", "soft", "anti", "pref"])
+            cpu = int(rng.choice([300, 500, 800]))
+            if kind == "plain":
+                ts.append(_template(f"t{k}", cpu))
+            elif kind == "spread":
+                ts.append(_template(
+                    f"t{k}", cpu,
+                    spread=(int(rng.choice([1, 2])),
+                            "topology.kubernetes.io/zone",
+                            {"tier": "web"}),
+                    labels={"app": f"t{k}", "tier": "web"}))
+            elif kind == "soft":
+                ts.append(_template(
+                    f"t{k}", cpu,
+                    soft=(1, "topology.kubernetes.io/zone",
+                          {"app": f"t{k}"})))
+            elif kind == "anti":
+                ts.append(_template(
+                    f"t{k}", cpu,
+                    anti=("kubernetes.io/hostname", {"app": f"t{k}"})))
+            else:
+                ts.append(_template(
+                    f"t{k}", cpu,
+                    pref_anti=(10, "kubernetes.io/hostname",
+                               {"tier": "web"}),
+                    labels={"app": f"t{k}", "tier": "web"}))
+        prof = SchedulerProfile.parity()
+        _assert_same(sweep_interleaved(snap, ts, prof),
+                     il.solve_interleaved_tensor(snap, ts, prof),
+                     f"fuzz-{seed}")
+
+
+def test_cross_matrix_diagonals_equal_self_increments():
+    """xinc[t, t] must reproduce the single-template self increments."""
+    from cluster_capacity_tpu.engine import encode as enc
+    from cluster_capacity_tpu.ops import inter_pod_affinity as ipa_ops
+    from cluster_capacity_tpu.parallel import sweep as sweep_mod
+
+    snap = ClusterSnapshot.from_objects(_nodes(8))
+    ts = [
+        _template("a", 400, spread=(1, "topology.kubernetes.io/zone",
+                                    {"app": "a"})),
+        _template("b", 300, spread=(2, "topology.kubernetes.io/zone",
+                                    {"app": "b"}),
+                  anti=("kubernetes.io/hostname", {"app": "b"})),
+    ]
+    prof = SchedulerProfile.parity()
+    keys = il.union_topology_keys(ts)
+    pbs = [enc.encode_problem(snap, t, prof, ipa_extra_keys=keys)
+           for t in ts]
+    pbs, _cfg, _dnh = sweep_mod._pad_group(pbs)
+    sh = il._spread_xinc(pbs, "spread_hard")
+    for t, pb in enumerate(pbs):
+        got = sh[t, t, :pb.spread_hard.self_match.shape[0]]
+        assert (got.astype(bool) == pb.spread_hard.self_match).all()
+    x = il._ipa_xinc(pbs)
+    for t, pb in enumerate(pbs):
+        _ga, _gn, aff_g, anti_g, pref_g = ipa_ops.group_fold(pb.ipa)
+        assert (x["aff_xinc"][t, t] == aff_g).all()
+        assert (x["anti_xinc"][t, t] == anti_g).all()
+        assert (x["pref_xinc"][t, t] == pref_g).all()
+
+
+def test_fallback_reasons():
+    snap = ClusterSnapshot.from_objects(_nodes(6))
+    prof = SchedulerProfile.parity()
+
+    # priorities differ → preemption pressure → object path
+    hi = _template("hi", 400)
+    hi["spec"]["priority"] = 10
+    assert il.solve_interleaved_tensor(snap, [hi, _template("b", 300)],
+                                       prof) is None
+
+    # extenders → object path
+    from cluster_capacity_tpu.engine.extenders import ExtenderConfig
+    prof2 = SchedulerProfile.parity()
+    prof2.extenders = [ExtenderConfig(
+        filter_callable=lambda p, names: {"NodeNames": names})]
+    assert il.solve_interleaved_tensor(snap, [_template("a", 300)],
+                                       prof2) is None
+
+    # host ports → object path
+    port = _template("p", 300)
+    port["spec"]["containers"][0]["ports"] = [{"hostPort": 8080}]
+    assert il.solve_interleaved_tensor(snap, [port], prof) is None
+
+    # the auto front door still answers (object fallback)
+    res = il.sweep_interleaved_auto(snap, [port], prof, max_total=3)
+    assert res[0].placed_count == 3
+
+
+def test_curability_transition_matches_object_path():
+    """Regression (review r3): a template whose park reason DEGRADES from
+    curable (absent affinity anchor) to non-curable (Insufficient cpu) must
+    stop requeueing exactly when the object path does — wrong staleness
+    shows up as LimitReached-vs-Unschedulable flips at quota boundaries."""
+    nodes = [{"metadata": {"name": f"n{i}", "labels": {
+                "kubernetes.io/hostname": f"n{i}",
+                "topology.kubernetes.io/zone": "z1"}},
+              "spec": {},
+              "status": {"allocatable": {"cpu": "1000m",
+                                         "memory": str(8 * 1024 ** 3),
+                                         "pods": "20"}}} for i in range(2)]
+    snap = ClusterSnapshot.from_objects(nodes)
+    a = _template("a", 600, aff=("topology.kubernetes.io/zone",
+                                 {"app": "missing-anchor"}))
+    b = _template("b", 400)
+    c = _template("c", 100)
+    prof = SchedulerProfile.parity()
+    for mt in (0, 3, 5, 6, 8, 9, 12):
+        ref = sweep_interleaved(snap, [a, b, c], prof, max_total=mt)
+        got = il.solve_interleaved_tensor(snap, [a, b, c], prof,
+                                          max_total=mt)
+        _assert_same(ref, got, f"transition mt={mt}")

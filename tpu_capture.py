@@ -160,6 +160,9 @@ STAGES = [
 
 
 def _done_stages() -> set:
+    """Stages that succeeded OR failed deterministically (a stage that
+    returned an {'error': ...} record with a clean exit is a real answer —
+    e.g. 'not kernel-eligible' — and must not block later stages)."""
     done = set()
     if os.path.exists(OUT):
         for line in open(OUT):
@@ -167,7 +170,7 @@ def _done_stages() -> set:
                 rec = json.loads(line)
             except json.JSONDecodeError:
                 continue
-            if rec.get("ok") and rec.get("stage"):
+            if rec.get("stage") and (rec.get("ok") or rec.get("settled")):
                 done.add(rec["stage"])
     return done
 
@@ -179,27 +182,31 @@ def ladder() -> bool:
         if name in done:
             continue
         t0 = time.time()
+        settled = False                 # deterministic answer (even if error)
         try:
             r = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), "stage"],
                 env=dict(os.environ, BENCH_STAGE=name),
                 capture_output=True, text=True, timeout=timeout)
-            out = (r.stdout.strip().splitlines() or ["{}"])[-1]
-            rec = json.loads(out) if r.returncode == 0 else {
-                "error": f"rc={r.returncode}",
-                "stderr": r.stderr[-1200:]}
+            if r.returncode == 0:
+                rec = json.loads((r.stdout.strip().splitlines() or ["{}"])[-1])
+                settled = True          # the stage ran to completion
+            else:
+                rec = {"error": f"rc={r.returncode}",
+                       "stderr": r.stderr[-1200:]}
         except subprocess.TimeoutExpired:
-            rec = {"error": f"timeout {timeout}s"}
+            rec = {"error": f"timeout {timeout}s"}   # tunnel likely wedged
         except Exception as e:
             rec = {"error": f"{type(e).__name__}: {e}"}
         ok = "error" not in rec
-        _append({"stage": name, "ok": ok, "wall_s": round(time.time() - t0, 1),
-                 **rec})
+        _append({"stage": name, "ok": ok, "settled": settled,
+                 "wall_s": round(time.time() - t0, 1), **rec})
         print(f"[capture] {name}: {'ok' if ok else rec.get('error')}",
               flush=True)
-        if not ok:
+        if ok or settled:
+            done.add(name)              # answered; move to the next stage
+        else:
             return False                # tunnel likely died; re-probe first
-        done.add(name)
     return len(done) >= len(STAGES)
 
 
