@@ -197,18 +197,27 @@ def encode_problem(snapshot: ClusterSnapshot, pod: dict,
             dra_missing_class = True
         else:
             shared_req_vec[j] = v
-    if dra_enc.slot_requests:
+    if dra_enc.slot_requests or dra_enc.shared_slot_requests:
         # structured allocator (CEL selectors / adminAccess / partitionable
         # devices): one virtual per-node column — allocatable = max clones
-        # the node's free devices support, each clone requests 1
-        slots = dra.compute_slot_columns(snapshot, dra_enc.slot_requests)
+        # the node's free devices support, each clone requests 1.  An
+        # unallocated shared named claim's structured requests are reserved
+        # once per node inside the column (its +1 is charged to the FIRST
+        # clone through shared_req_vec; dra_shared_colocate keeps every
+        # later clone on the allocation's node).
+        slots = dra.compute_slot_columns(
+            snapshot, dra_enc.slot_requests,
+            shared_reqs=dra_enc.shared_slot_requests)
         resource_names = resource_names + [dra.DRA_SLOTS_RESOURCE]
         allocatable = np.concatenate(
             [allocatable, slots[:, None]], axis=1)
         init_requested = np.concatenate(
             [init_requested, np.zeros((n, 1))], axis=1)
-        req_vec = np.concatenate([req_vec, [1.0]])
-        shared_req_vec = np.concatenate([shared_req_vec, [0.0]])
+        req_vec = np.concatenate(
+            [req_vec, [1.0 if dra_enc.slot_requests else 0.0]])
+        shared_req_vec = np.concatenate(
+            [shared_req_vec,
+             [1.0 if dra_enc.shared_slot_requests else 0.0]])
         r = len(resource_names)
     cpu_nz, mem_nz = ps.pod_nonzero_cpu_mem(pod)
     req_nonzero = np.asarray([cpu_nz, mem_nz], dtype=np.float64)

@@ -30,7 +30,7 @@ TPU-native reduction implemented here:
 
 from __future__ import annotations
 
-import re
+import functools
 from dataclasses import dataclass, field
 from typing import Dict, List, Mapping, Optional, Sequence, Tuple
 
@@ -63,6 +63,9 @@ class DraEncoding:
     # requests handled by the host-side structured allocator (CEL/admin);
     # they fold into the per-node dra/__slots__ virtual column
     slot_requests: List[SlotRequest] = field(default_factory=list)
+    # structured requests of UNALLOCATED shared named claims: reserved once
+    # on the first clone's node (the allocation), before per-clone slots
+    shared_slot_requests: List[SlotRequest] = field(default_factory=list)
     # pod references a shared claim → all clones colocate
     shared_claim_colocate: bool = False
     # node selectors from already-allocated claims (every one must match)
@@ -72,233 +75,69 @@ class DraEncoding:
 
 
 # ---------------------------------------------------------------------------
-# CEL device-selector evaluation (host-side subset)
+# CEL device-selector evaluation
 #
 # DRA selectors are CEL expressions over `device`
 # (resource.k8s.io DeviceSelector.cel.expression), e.g.
 #   device.attributes["driver.example.com"].model == "a100"
 #   device.capacity["driver.example.com"].memory >= 40
-# The practical subset — attribute/capacity lookups, comparisons, &&/||/!,
-# `in`, literals — maps onto Python expression syntax after swapping the
-# boolean operators, and evaluates against a small device view object.
+# Evaluated by ops/cel.py — a real lexer/parser/evaluator with CEL
+# semantics (truncating int division, error-absorbing && / ||, typed
+# arithmetic, string functions, has(), quantity()).  No Python eval is
+# involved anywhere: selectors come from CLUSTER objects (a live sync
+# pulls anyone's ResourceClaimTemplates), and the closed tree walker
+# cannot reach Python state; memory stays linear in expression length.
 # ---------------------------------------------------------------------------
 
-class _SafeStr(str):
-    """Device-sourced strings as seen by eval(): comparisons and `in`
-    work, but repetition/concatenation raise — `device.driver * 10**9`
-    must not allocate gigabytes (the static allowlist only sees literal
-    operands; this closes the Attribute/Subscript route)."""
-
-    def _refuse(self, *_a):
-        raise TypeError("string arithmetic outside the CEL subset")
-
-    __mul__ = __rmul__ = __add__ = __radd__ = __mod__ = _refuse
-
-    def __getitem__(self, i):
-        # CEL has no string index operator — the reference's CEL runtime
-        # errors and marks the device non-matching, so raising here (and
-        # not handing back a plain, arithmetic-capable str) is both the
-        # parity behavior and the DoS guard
-        raise TypeError("string indexing outside the CEL subset")
-
+from . import cel as cel_mod
 
 _CEL_INT_MIN, _CEL_INT_MAX = -2 ** 63, 2 ** 63 - 1
+_CEL_MAX_EXPR_LEN = cel_mod.MAX_EXPR_LEN
 
 
-def _safe_value(v):
-    if isinstance(v, str):
-        return _SafeStr(v)
-    if isinstance(v, bool) or v is None:
+def _cel_value(v):
+    """CEL attribute values are string/int/bool/double only; a
+    cluster-sourced value outside that (or an int past int64) is a CEL
+    type error → the device does not match."""
+    if isinstance(v, (str, bool, float)) or v is None:
         return v
     if isinstance(v, int):
         if not _CEL_INT_MIN <= v <= _CEL_INT_MAX:
-            # CEL ints are int64; a cluster-sourced bignum outside the
-            # range is a CEL error (→ non-match), and refusing it also
-            # stops arithmetic amplification over unbounded Python ints
-            raise OverflowError("attribute outside CEL int64 range")
+            raise cel_mod.CelError("attribute outside CEL int64 range")
         return v
-    if isinstance(v, float):
-        return v
-    # CEL attribute values are string/int/bool/version only — anything
-    # else a hostile slice smuggles in (e.g. a LIST, which would make
-    # `attr * 10**9` allocate gigabytes) is a CEL type error → non-match
-    raise TypeError(f"attribute type outside the CEL subset: {type(v)!r}")
+    raise cel_mod.CelError(f"attribute type outside CEL: {type(v)!r}")
 
 
-class _AttrView:
-    """Attribute access over one qualified-name namespace."""
-
-    def __init__(self, values: Mapping):
-        self._values = dict(values)
-
-    def __getattr__(self, name):
-        if name.startswith("_"):
-            raise AttributeError(name)
-        if name not in self._values:
-            raise KeyError(name)
-        return _safe_value(self._values[name])
-
-    def __getitem__(self, name):
-        return _safe_value(self._values[name])
+def _device_vars(device: "Device") -> dict:
+    return {"device": {
+        "driver": device.driver,
+        "attributes": {dom: {k: _cel_value(v) for k, v in vals.items()}
+                       for dom, vals in device.attributes.items()},
+        "capacity": {dom: {k: _cel_value(v) for k, v in vals.items()}
+                     for dom, vals in device.capacity.items()},
+    }}
 
 
-class _QualifiedMap:
-    """device.attributes / device.capacity: indexed by driver domain."""
-
-    def __init__(self, by_domain: Mapping[str, Mapping]):
-        self._by_domain = {d: _AttrView(v) for d, v in by_domain.items()}
-
-    def __getitem__(self, domain):
-        if domain not in self._by_domain:
-            return _AttrView({})
-        return self._by_domain[domain]
-
-    def __contains__(self, domain):
-        return domain in self._by_domain
-
-
-class DeviceView:
-    def __init__(self, device: "Device"):
-        self.attributes = _QualifiedMap(device.attributes)
-        self.capacity = _QualifiedMap(device.capacity)
-        self.driver = _SafeStr(device.driver)
-
-
-_WORD_RE = re.compile(r"[A-Za-z_][A-Za-z_0-9]*")
-
-
-def _cel_to_python(expr: str) -> str:
-    """Token-aware rewrite of the CEL operators/literals Python lacks —
-    string literals and identifier substrings must pass through untouched
-    (e.g. a selector comparing an attribute to the STRING \"true\")."""
-    out = []
-    i = 0
-    n = len(expr)
-    while i < n:
-        ch = expr[i]
-        if ch in "\"'":                       # copy string literals verbatim
-            j = i + 1
-            while j < n and expr[j] != ch:
-                j += 2 if expr[j] == "\\" else 1
-            out.append(expr[i:j + 1])
-            i = j + 1
-        elif expr.startswith("&&", i):
-            out.append(" and ")
-            i += 2
-        elif expr.startswith("||", i):
-            out.append(" or ")
-            i += 2
-        elif ch == "!" and not expr.startswith("!=", i):
-            out.append(" not ")
-            i += 1
-        elif ch.isalpha() or ch == "_":
-            m = _WORD_RE.match(expr, i)
-            word = m.group(0)
-            out.append({"true": "True", "false": "False"}.get(word, word))
-            i = m.end()
-        else:
-            out.append(ch)
-            i += 1
-    return "".join(out)
-
-
-_ALLOWED_CEL_NODES = (
-    "Expression", "BoolOp", "And", "Or", "UnaryOp", "Not", "USub",
-    "Compare", "Eq", "NotEq", "Lt", "LtE", "Gt", "GtE", "In", "NotIn",
-    "Attribute", "Subscript", "Name", "Load", "Constant",
-    # no Div/Mod: CEL truncates toward zero while Python true-divides /
-    # floors — a silently-different answer is worse than "outside the
-    # subset" (which means 'no match', same as a CEL runtime error in
-    # allocator.go)
-    "BinOp", "Add", "Sub", "Mult",
-    "List", "Tuple",                 # literal containers for `in [...]`
-)
-
-_CEL_MAX_EXPR_LEN = 4096
-
-
-def _rooted_at_device(node) -> bool:
-    """True iff an Attribute/Subscript chain bottoms out at the `device`
-    Name — i.e. the value came through DeviceView, whose _SafeStr wrapping
-    refuses string arithmetic at runtime."""
-    import ast
-    while isinstance(node, (ast.Attribute, ast.Subscript)):
-        node = node.value
-    return isinstance(node, ast.Name) and node.id == "device"
-
-
-def _arith_operand_safe(node) -> bool:
-    """Positive allowlist for BinOp operands: a hostile selector must not
-    get a str/list into `*`/`+` (`[0] * 10**9`, `("a" or "b") * 10**9`,
-    `["a"][0] * 10**9` all allocate unbounded memory inside eval()).
-    Allowed: numeric literals, nested arithmetic (operands checked by the
-    walk), unary minus over those, and device-rooted lookups (strings
-    there are _SafeStr and refuse arithmetic at runtime)."""
-    import ast
-    if isinstance(node, ast.Constant):
-        return isinstance(node.value, (int, float, complex, bool))
-    if isinstance(node, ast.BinOp):
-        return True
-    if isinstance(node, ast.UnaryOp):
-        return _arith_operand_safe(node.operand)
-    if isinstance(node, (ast.Attribute, ast.Subscript)):
-        return _rooted_at_device(node)
-    return False
-
-
-def _cel_expr_safe(py_expr: str) -> bool:
-    """Static AST allowlist: selectors come from CLUSTER objects (a live
-    sync pulls anyone's ResourceClaimTemplates), so eval() must only ever
-    see comparisons over the `device` view — no calls, no dunders, no
-    other names, no lookups rooted anywhere but `device`, and arithmetic
-    only over numbers or device-rooted values (see _arith_operand_safe)."""
-    import ast
-    # the raw selector is capped at _CEL_MAX_EXPR_LEN before the rewrite
-    # (cel_matches); the rewrite expands operators at most 5x ('!' →
-    # ' not '), so this bound is purely defensive and must NOT bite
-    # legitimate selectors under the raw cap
-    if len(py_expr) > 5 * _CEL_MAX_EXPR_LEN + 16:
-        return False
-    try:
-        tree = ast.parse(py_expr, mode="eval")
-    except SyntaxError:
-        return False
-    for node in ast.walk(tree):
-        if type(node).__name__ not in _ALLOWED_CEL_NODES:
-            return False
-        if isinstance(node, ast.Name) and node.id != "device":
-            return False
-        if isinstance(node, ast.Attribute) and node.attr.startswith("_"):
-            return False
-        if isinstance(node, ast.Constant) and isinstance(node.value, str) \
-                and "__" in node.value:
-            return False
-        if isinstance(node, (ast.Attribute, ast.Subscript)) \
-                and not _rooted_at_device(node):
-            return False
-        if isinstance(node, ast.BinOp) and not (
-                _arith_operand_safe(node.left)
-                and _arith_operand_safe(node.right)):
-            return False
-    return True
+@functools.lru_cache(maxsize=512)
+def _compiled(expr: str):
+    return cel_mod.compile_expr(expr)
 
 
 def cel_matches(expr: str, device: "Device") -> bool:
     """Evaluate one CEL selector against a device.  Failed lookups,
-    evaluation errors, and expressions outside the supported subset mean
-    'does not match' (the reference treats runtime CEL errors as a
-    non-matching device with an event, allocator.go)."""
-    if len(expr) > _CEL_MAX_EXPR_LEN:
-        return False          # refuse oversized selectors before the
-                              # O(n) token rewrite even runs
-    py_expr = _cel_to_python(expr)
-    if not _cel_expr_safe(py_expr):
-        return False
+    evaluation/type errors, and malformed expressions mean 'does not
+    match' (the reference treats runtime CEL errors as a non-matching
+    device with an event, allocator.go)."""
     try:
-        return bool(eval(py_expr,                             # noqa: S307
-                         {"__builtins__": {}},
-                         {"device": DeviceView(device)}))
+        ast = _compiled(expr)
+        return cel_mod.evaluate(ast, _device_vars(device)) is True
+    except cel_mod.CelError:
+        return False
     except Exception:
+        # defense in depth: selectors are cluster-controlled, and a crash
+        # here would abort the whole capacity run — any escape from the
+        # evaluator (e.g. an unforeseen Recursion/OverflowError) is the
+        # same "device does not match" the reference's CEL-error path takes
         return False
 
 
@@ -413,17 +252,16 @@ def _request_eligible(dev: Device, req: SlotRequest,
     return True
 
 
-def _fits_k_clones(k: int, units: List[List[int]],
-                   n_devices: int, consumes: List[Dict],
-                   pools: Dict) -> bool:
-    """Can k identical clones be allocated?  units = per-clone unit requests
-    as eligible device-index lists; devices are exclusive and counter pools
-    shared.  Greedy fewest-options-first with counter tracking — the same
-    first-fit shape as the reference's structured allocator."""
-    used = [False] * n_devices
+def _greedy_assign(all_units: List[List[int]], n_devices: int,
+                   consumes: List[Dict], pools: Dict,
+                   used: Optional[List[bool]] = None):
+    """Greedy fewest-options-first assignment with counter tracking — the
+    same first-fit shape as the reference's structured allocator.  Returns
+    (used, remaining_pools) or None when some unit cannot place.  `used`
+    seeds already-reserved devices (shared-claim reservation)."""
+    used = list(used) if used is not None else [False] * n_devices
     remaining = dict(pools)
-    all_units = sorted(units * k, key=len)
-    for elig in all_units:
+    for elig in sorted(all_units, key=len):
         placed = False
         for di in elig:
             if used[di]:
@@ -438,18 +276,34 @@ def _fits_k_clones(k: int, units: List[List[int]],
             placed = True
             break
         if not placed:
-            return False
-    return True
+            return None
+    return used, remaining
 
 
-def compute_slot_columns(snapshot, reqs: List[SlotRequest]
-                         ):
+def _fits_k_clones(k: int, units: List[List[int]],
+                   n_devices: int, consumes: List[Dict],
+                   pools: Dict, used=None) -> bool:
+    """Can k identical clones be allocated (on top of `used` devices)?"""
+    return _greedy_assign(units * k, n_devices, consumes, pools,
+                          used=used) is not None
+
+
+def compute_slot_columns(snapshot, reqs: List[SlotRequest],
+                         shared_reqs: Sequence[SlotRequest] = ()):
     """Per-node max clone count for the structured requests (the
     dra/__slots__ virtual column) — host-side, once per encode.
 
     Devices already held by existing pods' template claims are removed
     first (greedy, class-eligibility only — their selectors are not
-    re-evaluated, matching the allocator's first-fit)."""
+    re-evaluated, matching the allocator's first-fit).
+
+    shared_reqs are an UNALLOCATED shared named claim's structured
+    requests: they are reserved ONCE per node before the per-clone
+    computation (the allocation the first clone would trigger; all clones
+    colocate there, dra_shared_colocate).  The returned column then counts
+    1 for the shared allocation itself — charged to the first clone via
+    the shared_req_vec mechanism — plus one per clone; a node that cannot
+    host the shared allocation gets 0."""
     import numpy as np
 
     templates_by_key = claim_index(snapshot.resource_claim_templates)
@@ -457,7 +311,7 @@ def compute_slot_columns(snapshot, reqs: List[SlotRequest]
     admin_ok = np.ones(snapshot.num_nodes, dtype=bool)
     class_sel = {r.device_class: _class_selectors(snapshot.device_classes,
                                                   r.device_class)
-                 for r in reqs}
+                 for r in list(reqs) + list(shared_reqs)}
     # one bucketing pass over the slices, not one scan per node
     slices_by_node: Dict[str, List[Mapping]] = {}
     for rs in snapshot.resource_slices:
@@ -486,7 +340,7 @@ def compute_slot_columns(snapshot, reqs: List[SlotRequest]
         # admin-access requests need an eligible device to exist, consumed
         # or not (they never allocate exclusively, dynamicresources
         # AdminAccess semantics); a node failing one is infeasible outright
-        for r in reqs:
+        for r in list(reqs) + list(shared_reqs):
             if r.admin_access and not any(
                     _request_eligible(d, r, class_sel[r.device_class])
                     for d in devices):
@@ -494,41 +348,58 @@ def compute_slot_columns(snapshot, reqs: List[SlotRequest]
         if not admin_ok[i]:
             continue                    # slots stay 0 → Insufficient
 
-        consuming = [r for r in reqs if not r.admin_access]
-        if not consuming:
-            slots[i] = _SLOTS_UNLIMITED
-            continue
-        units: List[List[int]] = []
-        all_mode_empty = False
-        for r in consuming:
-            elig = [di for di, d in enumerate(free)
-                    if _request_eligible(d, r, class_sel[r.device_class])]
-            if r.count == COUNT_ALL:
-                # allocationMode All: the clone takes every matching device;
-                # at least one must exist (resource/v1 types.go:847)
-                if not elig:
-                    all_mode_empty = True
-                    break
-                units.extend([elig] * len(elig))
-            else:
-                units.extend([elig] * r.count)
-        if all_mode_empty:
-            continue                    # slots stay 0 → cannot allocate
         consumes = [d.consumes for d in free]
+
+        def build_units(rs_list):
+            units: List[List[int]] = []
+            for r in rs_list:
+                if r.admin_access:
+                    continue
+                elig = [di for di, d in enumerate(free)
+                        if _request_eligible(d, r,
+                                             class_sel[r.device_class])]
+                if r.count == COUNT_ALL:
+                    # allocationMode All: take every matching device; at
+                    # least one must exist (resource/v1 types.go:847)
+                    if not elig:
+                        return None
+                    units.extend([elig] * len(elig))
+                else:
+                    units.extend([elig] * r.count)
+            return units
+
+        used0 = None
+        pools0 = pools
+        extra = 0.0
+        if shared_reqs:
+            shared_units = build_units(shared_reqs)
+            if shared_units is None:
+                continue                # All-mode shared with no devices
+            got = _greedy_assign(shared_units, len(free), consumes, pools)
+            if got is None:
+                continue                # node cannot host the allocation
+            used0, pools0 = got
+            extra = 1.0                 # the first clone's shared charge
+
+        units = build_units(reqs)
+        if units is None:
+            continue                    # slots stay 0 → cannot allocate
         if not units:
             slots[i] = _SLOTS_UNLIMITED
             continue
-        cap = len(free) // max(1, len(units))
+        n_used0 = sum(used0) if used0 else 0
+        cap = (len(free) - n_used0) // max(1, len(units))
         # binary search first: its answer f satisfies fits(f), so it is a
         # sound floor even when greedy feasibility is non-monotone
         lo, hi = 0, cap
         while lo < hi:
             mid = (lo + hi + 1) // 2
-            if _fits_k_clones(mid, units, len(free), consumes, pools):
+            if _fits_k_clones(mid, units, len(free), consumes, pools0,
+                              used=used0):
                 lo = mid
             else:
                 hi = mid - 1
-        if pools or any(consumes):
+        if pools0 or any(consumes):
             # with shared counter pools greedy first-fit is NOT provably
             # monotone in k, so the search may have discarded a feasible
             # upper region — rescue with O(log cap) probes stepping down
@@ -537,12 +408,13 @@ def compute_slot_columns(snapshot, reqs: List[SlotRequest]
             # on the reference's backtracking allocator either way.
             step, k = 1, cap
             while k > lo:
-                if _fits_k_clones(k, units, len(free), consumes, pools):
+                if _fits_k_clones(k, units, len(free), consumes, pools0,
+                                  used=used0):
                     lo = k
                     break
                 k -= step
                 step *= 2
-        slots[i] = float(lo)
+        slots[i] = float(lo) + extra
     return slots
 
 
@@ -646,6 +518,7 @@ def encode(pod: Mapping, resource_claims: Sequence[Mapping],
     templates = claim_index(resource_claim_templates)
 
     template_specs: List[Mapping] = []
+    shared_specs: List[Mapping] = []    # unallocated shared named claims
     for ref in refs:
         claim_name = ref.get("resourceClaimName")
         tmpl_name = ref.get("resourceClaimTemplateName")
@@ -662,10 +535,8 @@ def encode(pod: Mapping, resource_claims: Sequence[Mapping],
                 # were charged to that node at snapshot build
                 enc.allocation_node_selectors.append(selector)
             else:
-                # unallocated: first clone allocates → devices charged once
-                for k, v in _claim_requests(claim.get("spec") or {}).items():
-                    enc.shared_first_requests[k] = \
-                        enc.shared_first_requests.get(k, 0) + v
+                # unallocated: the first clone allocates it
+                shared_specs.append(claim.get("spec") or {})
         elif tmpl_name:
             tmpl = templates.get((ns, tmpl_name))
             if tmpl is None:
@@ -677,17 +548,27 @@ def encode(pod: Mapping, resource_claims: Sequence[Mapping],
     all_sreqs: List[SlotRequest] = []
     for claim_spec in template_specs:
         all_sreqs.extend(_claim_slot_requests(claim_spec))
-    if all_sreqs and (has_shared_counters
-                      or _needs_structured(all_sreqs, device_classes)):
-        # one structured request pulls EVERY template request into the
-        # slot allocator — mixing paths would double-account devices a
-        # plain request and a selector request both want
+    shared_sreqs: List[SlotRequest] = []
+    for claim_spec in shared_specs:
+        shared_sreqs.extend(_claim_slot_requests(claim_spec))
+    if (all_sreqs or shared_sreqs) and (
+            has_shared_counters
+            or _needs_structured(all_sreqs + shared_sreqs, device_classes)):
+        # one structured request pulls EVERY request — template AND shared
+        # — into the slot allocator: mixing paths would double-account
+        # devices a plain request and a selector request both want
         enc.slot_requests = all_sreqs
+        enc.shared_slot_requests = shared_sreqs
     else:
         for claim_spec in template_specs:
             for k, v in _claim_requests(claim_spec).items():
                 enc.per_clone_requests[k] = \
                     enc.per_clone_requests.get(k, 0) + v
+        for claim_spec in shared_specs:
+            # devices charged once, at the first placement
+            for k, v in _claim_requests(claim_spec).items():
+                enc.shared_first_requests[k] = \
+                    enc.shared_first_requests.get(k, 0) + v
     return enc
 
 

@@ -53,7 +53,6 @@ from ..engine import simulator as sim
 from ..models import podspec as ps
 from ..models.snapshot import ClusterSnapshot
 from ..ops import inter_pod_affinity as ipa_ops
-from ..ops import pod_topology_spread as spread_ops
 from ..utils.config import SchedulerProfile
 
 # total per-template-tensor elements (T*C*N summed over the ~7 stacked count
@@ -235,12 +234,9 @@ def _preemption_impossible(snapshot: ClusterSnapshot,
     return True
 
 
-def eligible(snapshot: ClusterSnapshot, templates: Sequence[dict],
-             profile: SchedulerProfile, pbs) -> Optional[str]:
-    """None when the tensor engine can run this study; otherwise the reason
-    for the object-path fallback."""
-    from . import sweep as sweep_mod
-
+def eligible_profile(snapshot: ClusterSnapshot, templates: Sequence[dict],
+                     profile: SchedulerProfile) -> Optional[str]:
+    """Profile/priority gates checkable BEFORE the O(T*N) encode pass."""
     if not profile.deterministic:
         return "non-deterministic tie-break"
     if profile.extenders:
@@ -250,6 +246,18 @@ def eligible(snapshot: ClusterSnapshot, templates: Sequence[dict],
     if "DefaultPreemption" in profile.post_filters and \
             not _preemption_impossible(snapshot, templates):
         return "preemption pressure (priorities differ)"
+    return None
+
+
+def eligible(snapshot: ClusterSnapshot, templates: Sequence[dict],
+             profile: SchedulerProfile, pbs) -> Optional[str]:
+    """None when the tensor engine can run this study; otherwise the reason
+    for the object-path fallback."""
+    from . import sweep as sweep_mod
+
+    reason = eligible_profile(snapshot, templates, profile)
+    if reason is not None:
+        return reason
     solvable = [pb for pb in pbs
                 if pb.pod_level_reason is None
                 and not (pb.pod.get("spec") or {}).get("schedulingGates")]
@@ -470,13 +478,14 @@ def solve_interleaved_tensor(snapshot: ClusterSnapshot,
     import jax.numpy as jnp
 
     from . import sweep as sweep_mod
-    from ..ops import volumes as vol_ops
 
     profile = profile or SchedulerProfile()
     templates = list(templates)
     n = snapshot.num_nodes
     if n == 0 or not templates:
         return None
+    if eligible_profile(snapshot, templates, profile) is not None:
+        return None                     # before the O(T*N) encode pass
 
     sim._ensure_x64(profile)
     extra_keys = union_topology_keys(templates)

@@ -1,0 +1,223 @@
+"""CEL evaluator semantics (ops/cel.py).
+
+The reference evaluates DRA selectors with cel-go + the Kubernetes DRA
+environment (vendor/.../dynamicresources/ structured allocator); this
+suite pins the cel-spec behaviors the old token-rewrite subset could not
+express: error-absorbing logical operators, truncating integer division,
+typed arithmetic, lazy ternary, string functions, has(), quantity().
+"""
+
+import pytest
+
+from cluster_capacity_tpu.ops import cel
+from cluster_capacity_tpu.ops.dynamic_resources import Device, cel_matches
+
+
+def ev(expr, **variables):
+    return cel.evaluate(cel.compile_expr(expr), variables)
+
+
+def _dev(attrs=None, caps=None):
+    return Device(name="d", device_class="gpu.example.com",
+                  driver="gpu.example.com",
+                  attributes=attrs or {}, capacity=caps or {})
+
+
+# --- logical operators (cel-spec: commutative error absorption) -----------
+
+def test_logical_error_absorption():
+    dev = _dev()
+    # false && <error> is false, not an error
+    assert cel_matches('false && device.attributes["x"].y == 1', dev) is False
+    assert cel_matches('device.attributes["x"].y == 1 && false', dev) is False
+    # true || <error> is true
+    assert cel_matches('true || device.attributes["x"].y == 1', dev) is True
+    assert cel_matches('device.attributes["x"].y == 1 || true', dev) is True
+    # true && <error> propagates the error -> non-match
+    assert cel_matches('true && device.attributes["x"].y == 1', dev) is False
+    # non-boolean operands are type errors
+    assert cel_matches('1 && true', dev) is False
+    with pytest.raises(cel.CelError):
+        ev("1 || false")
+
+
+def test_ternary_is_lazy():
+    assert ev("true ? 1 : 2") == 1
+    assert ev("false ? 1 : 2") == 2
+    # the untaken branch must not evaluate
+    assert ev("true ? 1 : missing") == 1
+    with pytest.raises(cel.CelError):
+        ev("false ? 1 : missing")
+
+
+# --- arithmetic typing ----------------------------------------------------
+
+def test_int_arithmetic_truncates():
+    assert ev("7 / 2") == 3
+    assert ev("-7 / 2") == -3
+    assert ev("7 / -2") == -3
+    assert ev("-7 % 2") == -1
+    assert ev("7 % -2") == 1
+    assert ev("6.0 / 4.0") == 1.5
+
+
+def test_type_errors():
+    for bad in ('"a" + 1', '[1] + "a"', '"a" * 2', "[1] * 2", "true + 1",
+                "1 / 0", "1 % 0", '- "a"', '!"a"', '"a" < 1'):
+        with pytest.raises(cel.CelError):
+            ev(bad)
+
+
+def test_concatenation_and_compare():
+    assert ev('"foo" + "bar" == "foobar"') is True
+    assert ev("[1, 2] + [3] == [1, 2, 3]") is True
+    assert ev("1 < 2.5") is True            # cross-type numeric comparison
+    assert ev('"abc" < "abd"') is True
+    assert ev("1 == 1.0") is True
+    assert ev('1 == "1"') is False          # no cross-type equality
+    assert ev("true == 1") is False
+    assert ev("null == null") is True
+    assert ev("1 != null") is True
+
+
+# --- membership, indexing, maps ------------------------------------------
+
+def test_in_and_indexing():
+    assert ev('"a" in ["a", "b"]') is True
+    assert ev('"z" in ["a", "b"]') is False
+    assert ev('"k" in {"k": 1}') is True
+    assert ev('{"k": 1}["k"] == 1') is True
+    assert ev("[10, 20][1] == 20") is True
+    with pytest.raises(cel.CelError):
+        ev("[10][5]")
+    with pytest.raises(cel.CelError):
+        ev('"abc"[0]')                      # CEL has no string indexing
+
+
+# --- functions ------------------------------------------------------------
+
+def test_string_functions():
+    assert ev('"hello".startsWith("he")') is True
+    assert ev('"hello".endsWith("lo")') is True
+    assert ev('"hello".contains("ell")') is True
+    assert ev('"hello".matches("^h.*o$")') is True
+    assert ev('size("hello")') == 5
+    assert ev("size([1, 2])") == 2
+    assert ev('size({"a": 1})') == 1
+    with pytest.raises(cel.CelError):
+        ev('"x".matches("(")')              # bad regex -> error
+
+
+def test_conversions_and_quantity():
+    assert ev('int("42")') == 42
+    assert ev("double(3)") == 3.0
+    assert ev("string(7) == \"7\"") is True
+    assert ev('quantity("1Ki") == 1024') is True
+    assert ev('quantity("2Gi").isGreaterThan(quantity("1Gi"))') is True
+    assert ev('quantity("1Gi").compareTo(quantity("1Gi"))') == 0
+    assert ev('isQuantity("800m")') is True
+    assert ev('isQuantity("not-a-quantity")') is False
+
+
+def test_has_macro():
+    dev = _dev(attrs={"gpu.example.com": {"model": "a100"}})
+    assert cel_matches('has(device.attributes["gpu.example.com"].model)',
+                       dev) is True
+    assert cel_matches('has(device.attributes["gpu.example.com"].missing)',
+                       dev) is False
+    assert cel_matches('has(device.attributes["other.domain"].x)',
+                       dev) is False
+    # guarded lookup: the canonical has() idiom
+    assert cel_matches(
+        'has(device.attributes["gpu.example.com"].model) && '
+        'device.attributes["gpu.example.com"].model == "a100"', dev) is True
+
+
+def test_device_selector_end_to_end():
+    dev = _dev(attrs={"gpu.example.com": {"model": "a100", "sriov": True}},
+               caps={"gpu.example.com": {"memory": 40 * 1024 ** 3}})
+    assert cel_matches(
+        'device.capacity["gpu.example.com"].memory >= quantity("40Gi")',
+        dev) is True
+    assert cel_matches(
+        'device.capacity["gpu.example.com"].memory / quantity("1Gi") == 40',
+        dev) is True
+    assert cel_matches('device.driver.startsWith("gpu.")', dev) is True
+    assert cel_matches(
+        'device.attributes["gpu.example.com"].sriov ? '
+        'device.attributes["gpu.example.com"].model == "a100" : false',
+        dev) is True
+
+
+# --- robustness -----------------------------------------------------------
+
+def test_parse_guards():
+    with pytest.raises(cel.CelError):
+        cel.compile_expr("(" * 100 + "1" + ")" * 100)   # depth cap
+    with pytest.raises(cel.CelError):
+        cel.compile_expr("x" * (cel.MAX_EXPR_LEN + 1))  # length cap
+    with pytest.raises(cel.CelError):
+        cel.compile_expr('"unterminated')
+    with pytest.raises(cel.CelError):
+        cel.compile_expr("1 +")
+    with pytest.raises(cel.CelError):
+        cel.compile_expr("1 1")
+
+
+def test_undeclared_and_unknown():
+    with pytest.raises(cel.CelError):
+        ev("undeclared == 1")
+    with pytest.raises(cel.CelError):
+        ev("frobnicate(1)")
+    with pytest.raises(cel.CelError):
+        ev('"a".frobnicate()')
+
+
+def test_string_literals_untouched():
+    # operators inside string literals must not lex as operators
+    assert ev('"a && b" == "a && b"') is True
+    assert ev('"true" == "true"') is True
+    assert ev(r'"a\"b" == "a\"b"') is True
+    assert ev("'single' == \"single\"") is True
+
+
+# --- hostile-input robustness (review r3: confirmed crash/hang probes) ----
+
+def test_redos_pattern_is_linear_time():
+    """'(a+)+$' against 'aaa...b' is exponential in a backtracking engine;
+    the linear NFA must answer (False) quickly."""
+    import time
+    subject = "a" * 64 + "b"
+    t0 = time.time()
+    assert ev(f'"{subject}".matches("(a+)+$")') is False
+    assert time.time() - t0 < 2.0
+    # and the engine still matches real patterns
+    assert ev('"gpu-a100-x8".matches("a100|h100")') is True
+    assert ev('"gpu-a100-x8".matches("^gpu-[a-z0-9]+-x[0-9]{1,2}$")') is True
+    assert ev(r'"v1.2.3".matches("^v\\d+\\.\\d+\\.\\d+$")') is True
+    with pytest.raises(cel.CelError):
+        ev('"x".matches("(a")')          # bad pattern -> error
+    with pytest.raises(cel.CelError):
+        ev(r'"x".matches("(a)\\1")')     # backreferences unsupported (RE2)
+
+
+def test_malformed_literals_do_not_crash():
+    dev = _dev()
+    # these previously escaped as ValueError/OverflowError/RecursionError
+    assert cel_matches("1e5u == 100000.0", dev) is False
+    assert cel_matches("int(1.0e999) == 0", dev) is False
+    assert cel_matches("device" + ".x" * 1500 + " == 1", dev) is False
+    with pytest.raises(cel.CelError):
+        cel.compile_expr("1 + " * 200 + "1")     # deep left-nested tree
+
+
+def test_int64_overflow_is_an_error():
+    with pytest.raises(cel.CelError):
+        ev("9223372036854775807 + 1")
+    with pytest.raises(cel.CelError):
+        ev("9223372036854775807 * 2")
+    with pytest.raises(cel.CelError):
+        ev("-(-9223372036854775807 - 1)")
+    assert ev("9223372036854775806 + 1") == 2 ** 63 - 1
+    dev = _dev()
+    assert cel_matches("9223372036854775807 + 1 > 0", dev) is False

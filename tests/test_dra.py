@@ -329,21 +329,22 @@ def _mem_device(mem):
 
 
 def test_cel_literal_arithmetic_rejected():
-    """A hostile selector multiplying/adding list or str literals must be
-    refused statically, never eval'd ('[0] * 10**9' would allocate GBs)."""
+    """A hostile selector must not allocate unbounded memory: CEL has no
+    repetition operator, so 'X * 10**9' over a list/string is a TYPE error
+    (→ non-match) in the tree-walking evaluator — never an allocation."""
     from cluster_capacity_tpu.ops.dynamic_resources import cel_matches
     dev = _mem_device(4)
     assert cel_matches("[0] * 1000000000 == []", dev) is False
-    assert cel_matches("[0, 1] + [2] == [0, 1, 2]", dev) is False
+    # list CONCATENATION is real CEL (bounded by expression length)
+    assert cel_matches("[0, 1] + [2] == [0, 1, 2]", dev) is True
     assert cel_matches('"a" * 1000000000 == ""', dev) is False
-    # nested: the literal hides one BinOp down
+    # nested: the hostile operand hides one arithmetic node down
     assert cel_matches("([0] * 2) * 1000000000 == []", dev) is False
-    # device-SOURCED strings dodge the static literal check (Attribute/
-    # Subscript operands) — the runtime _SafeStr guard must refuse them
+    # device-SOURCED strings must not reach arithmetic either
     assert cel_matches('device.driver * 1000000000 != ""', dev) is False
     assert cel_matches('device.driver[0] * 1000000000 != ""', dev) is False
-    # subscripted/bool-op literal containers must not smuggle plain strs
-    # or lists into arithmetic either
+    # subscripted/bool-op containers must not smuggle strs or lists into
+    # arithmetic ('or' over strings is itself a CEL type error)
     assert cel_matches('["a"][0] * 1000000000 != ""', dev) is False
     assert cel_matches('[[0]][0] * 1000000000 != []', dev) is False
     assert cel_matches('("a" or "b") * 1000000000 != ""', dev) is False
@@ -369,16 +370,22 @@ def test_cel_numeric_arithmetic_still_works():
         'device.capacity["gpu.example.com"].memory * 2 == 8', dev) is True
 
 
-def test_cel_division_outside_subset():
-    """CEL / and % truncate toward zero, Python's floor — the subset
-    refuses both rather than silently diverging (parity-notes.md)."""
+def test_cel_division_truncates_toward_zero():
+    """CEL / and % truncate toward zero (cel-spec int arithmetic); Python
+    floors — the evaluator must implement the CEL behavior."""
     from cluster_capacity_tpu.ops.dynamic_resources import cel_matches
     dev = _mem_device(4)
     assert cel_matches(
-        'device.capacity["gpu.example.com"].memory / 2 >= 1', dev) is False
-    # Python (-4) % 3 == 2 but CEL -4 % 3 == -1: refusing beats over-matching
+        'device.capacity["gpu.example.com"].memory / 2 >= 1', dev) is True
     assert cel_matches(
-        'device.capacity["gpu.example.com"].memory % 3 == 1', dev) is False
+        'device.capacity["gpu.example.com"].memory % 3 == 1', dev) is True
+    # negative operands: CEL -7/2 == -3 (Python floors to -4) and
+    # -7 % 2 == -1 (Python gives +1)
+    assert cel_matches("(0 - 7) / 2 == 0 - 3", dev) is True
+    assert cel_matches("(0 - 7) % 2 == 0 - 1", dev) is True
+    assert cel_matches("-7 / 2 == -3", dev) is True
+    # division by zero is a CEL error -> non-match
+    assert cel_matches("1 / 0 == 0", dev) is False
 
 
 def test_cel_string_indexing_non_matching():
@@ -458,3 +465,89 @@ def test_counter_pool_count_matches_linear_probe():
     # binary-search artifact.
     assert best == 2
     assert res.placed_count == best
+
+
+def _shared_claim(name="shared", expr=None, count=1, mode=None,
+                  cls="gpu.example.com"):
+    req = {"name": "r0", "deviceClassName": cls, "count": count}
+    if expr:
+        req["selectors"] = [{"cel": {"expression": expr}}]
+    if mode:
+        req["allocationMode"] = mode
+    return {"metadata": {"name": name, "namespace": "default"},
+            "spec": {"devices": {"requests": [req]}}}
+
+
+def _pod_with_shared_claim(name, claim="shared"):
+    pod = build_test_pod(name, 100, 0)
+    pod["spec"]["resourceClaims"] = [{"name": "gpu",
+                                      "resourceClaimName": claim}]
+    return pod
+
+
+def test_shared_claim_with_cel_selector_structured():
+    """A shared named claim WITH a CEL selector must run the structured
+    allocator (VERDICT r2: it used to degrade to count-based matching):
+    only the node whose devices match the selector can host the one
+    allocation; all clones colocate there."""
+    nodes = [build_test_node("n1", 100000, int(1e11), 500),
+             build_test_node("n2", 100000, int(1e11), 500)]
+    a100s = [{"name": f"d{i}", "attributes": {
+        "gpu.example.com/model": {"string": "a100"}}} for i in range(2)]
+    t4s = [{"name": f"d{i}", "attributes": {
+        "gpu.example.com/model": {"string": "t4"}}} for i in range(2)]
+    claim = _shared_claim(
+        expr='device.attributes["gpu.example.com"].model == "a100"',
+        count=2)
+    cc = ClusterCapacity(default_pod(_pod_with_shared_claim("p")),
+                         max_limit=5, profile=SchedulerProfile.parity())
+    cc.sync_with_objects(
+        nodes, resource_slices=[_attr_slice("n1", a100s),
+                                _attr_slice("n2", t4s)],
+        resource_claims=[claim])
+    res = cc.run()
+    # count-based degrade would accept n2's two t4s; structured must not
+    assert res.placed_count == 5
+    assert set(res.per_node_counts) == {"n1"}
+
+
+def test_shared_claim_selector_no_matching_node():
+    nodes = [build_test_node("n1", 100000, int(1e11), 500)]
+    t4s = [{"name": "d0", "attributes": {
+        "gpu.example.com/model": {"string": "t4"}}}]
+    claim = _shared_claim(
+        expr='device.attributes["gpu.example.com"].model == "a100"')
+    cc = ClusterCapacity(default_pod(_pod_with_shared_claim("p")),
+                         profile=SchedulerProfile.parity())
+    cc.sync_with_objects(nodes, resource_slices=[_attr_slice("n1", t4s)],
+                         resource_claims=[claim])
+    res = cc.run()
+    assert res.placed_count == 0
+    assert res.fail_counts.get("cannot allocate all claims") == 1
+
+
+def test_shared_structured_claim_plus_template_claim():
+    """Shared structured claim + per-clone template claim share one device
+    pool: the shared allocation reserves its devices first, per-clone
+    slots come from the remainder."""
+    nodes = [build_test_node("n1", 100000, int(1e11), 500)]
+    devs = [{"name": f"d{i}", "attributes": {
+        "gpu.example.com/model": {"string": "a100"}}} for i in range(4)]
+    claim = _shared_claim(
+        expr='device.attributes["gpu.example.com"].model == "a100"')
+    tmpl = _sel_template(
+        "clone-gpu",
+        expr='device.attributes["gpu.example.com"].model == "a100"')
+    pod = build_test_pod("p", 100, 0)
+    pod["spec"]["resourceClaims"] = [
+        {"name": "shared-gpu", "resourceClaimName": "shared"},
+        {"name": "own-gpu", "resourceClaimTemplateName": "clone-gpu"}]
+    cc = ClusterCapacity(default_pod(pod),
+                         profile=SchedulerProfile.parity())
+    cc.sync_with_objects(nodes, resource_slices=[_attr_slice("n1", devs)],
+                         resource_claims=[claim],
+                         resource_claim_templates=[tmpl])
+    res = cc.run()
+    # 4 matching devices: 1 reserved by the shared allocation -> 3 clones
+    assert res.placed_count == 3
+    assert res.fail_counts.get("cannot allocate all claims") == 1
