@@ -817,36 +817,57 @@ def _build_kernel(pk: _Packing, k_steps: int):
     return kernel
 
 
+def _spec_table(pk: _Packing, k_steps: int):
+    """Operand spec table for _compiled_call — the single source both the
+    Mosaic lint (tests + runner-build guard) and the real pallas_call
+    construction read, so the lint can never drift from what lowers."""
+    from .mosaic_lint import SpecEntry
+    meta = pk.meta
+    n_const = len(pk.const_idx)
+    n_carry = len(pk.carry_idx)
+    ins = [
+        SpecEntry("const", (n_const, meta.s, LANES),
+                  (n_const, meta.s, LANES), "vmem"),
+        SpecEntry("carry_in", (n_carry, meta.s, LANES),
+                  (n_carry, meta.s, LANES), "vmem"),
+        SpecEntry("scalars_in", (1, 4), (1, 4), "smem"),
+    ]
+    outs = [
+        SpecEntry("carry_out", (n_carry, meta.s, LANES),
+                  (n_carry, meta.s, LANES), "vmem"),
+        SpecEntry("scalars_out", (1, 4), (1, 4), "smem"),
+        SpecEntry("chosen", (k_steps, 1), (k_steps, 1), "vmem"),
+    ]
+    return ins, outs
+
+
 @functools.lru_cache(maxsize=64)
 def _compiled_call(pk: _Packing, k_steps: int, interpret: bool):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
+    from .mosaic_lint import assert_clean
 
-    meta = pk.meta
     kernel = _build_kernel(pk, k_steps)
-    n_const = len(pk.const_idx)
-    n_carry = len(pk.carry_idx)
+    ins, outs = _spec_table(pk, k_steps)
+    assert_clean(ins + outs, f"fused kernel n={pk.meta.n} k={k_steps}")
+
+    spaces = {"vmem": pltpu.VMEM, "smem": pltpu.SMEM}
+
+    def spec(e):
+        return pl.BlockSpec(e.block_shape, memory_space=spaces[e.memory_space])
 
     out_shape = [
-        jax.ShapeDtypeStruct((n_carry, meta.s, LANES), jnp.float32),
-        jax.ShapeDtypeStruct((1, 4), jnp.float32),
-        jax.ShapeDtypeStruct((k_steps, 1), jnp.int32),
+        jax.ShapeDtypeStruct(outs[0].array_shape, jnp.float32),
+        jax.ShapeDtypeStruct(outs[1].array_shape, jnp.float32),
+        jax.ShapeDtypeStruct(outs[2].array_shape, jnp.int32),
     ]
     call = pl.pallas_call(
         kernel,
         out_shape=out_shape,
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 4), memory_space=pltpu.SMEM),
-        ],
-        out_specs=[
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 4), memory_space=pltpu.SMEM),
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-        ],
+        in_specs=[spec(e) for e in ins],
+        out_specs=[spec(e) for e in outs],
         interpret=interpret,
     )
     return jax.jit(call)

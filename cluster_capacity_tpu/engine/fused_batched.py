@@ -486,6 +486,40 @@ def _build_batched_kernel(pk: _Packing, tab: ScalarTable, k_steps: int,
     return kernel
 
 
+def _batched_spec_table(pk: _Packing, tab: ScalarTable, b: int, k_steps: int):
+    """Operand spec table for _compiled_batched_call (block shape, array
+    shape, memory space, grid index map) — the single source for both the
+    Mosaic lint and the real pallas_call construction.  The round-3 tunnel
+    window died on exactly this call's SMEM specs (`(1, 4)` blocks on a
+    `[B, 4]` array); the lint now rejects that shape off-hardware."""
+    from .mosaic_lint import SpecEntry
+    meta = pk.meta
+    n_const = len(pk.const_idx)
+    n_carry = len(pk.carry_idx)
+    s = meta.s
+    tile = _SMEM_TILE
+    b_pad = b + (-b % tile)
+    slab = lambda i: (i, 0, 0, 0)
+    srow = lambda i: (i // tile, 0)
+    ins = [
+        (SpecEntry("const_stack", (1, n_const, s, LANES),
+                   (b, n_const, s, LANES), "vmem"), slab),
+        (SpecEntry("carry_in", (1, n_carry, s, LANES),
+                   (b, n_carry, s, LANES), "vmem"), slab),
+        (SpecEntry("scalars_in", (tile, 4), (b_pad, 4), "smem"), srow),
+        (SpecEntry("scalar_table", (tile, tab.width),
+                   (b_pad, tab.width), "smem"), srow),
+    ]
+    outs = [
+        (SpecEntry("carry_out", (1, n_carry, s, LANES),
+                   (b, n_carry, s, LANES), "vmem"), slab),
+        (SpecEntry("scalars_out", (tile, 4), (b_pad, 4), "smem"), srow),
+        (SpecEntry("chosen", (1, k_steps, 1),
+                   (b, k_steps, 1), "vmem"), lambda i: (i, 0, 0)),
+    ]
+    return ins, outs
+
+
 @functools.lru_cache(maxsize=32)
 def _compiled_batched_call(pk: _Packing, tab: ScalarTable, b: int,
                            k_steps: int, max_dnh: int, interpret: bool):
@@ -493,42 +527,30 @@ def _compiled_batched_call(pk: _Packing, tab: ScalarTable, b: int,
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
+    from .mosaic_lint import assert_clean
 
-    meta = pk.meta
     kernel = _build_batched_kernel(pk, tab, k_steps, max_dnh)
-    n_const = len(pk.const_idx)
-    n_carry = len(pk.carry_idx)
-    s = meta.s
+    ins, outs = _batched_spec_table(pk, tab, b, k_steps)
+    assert_clean([e for e, _m in ins + outs],
+                 f"batched fused kernel b={b} n={pk.meta.n} k={k_steps}")
 
-    b_pad = b + (-b % _SMEM_TILE)
+    spaces = {"vmem": pltpu.VMEM, "smem": pltpu.SMEM}
+
+    def spec(e, index_map):
+        return pl.BlockSpec(e.block_shape, index_map,
+                            memory_space=spaces[e.memory_space])
+
     out_shape = [
-        jax.ShapeDtypeStruct((b, n_carry, s, LANES), jnp.float32),
-        jax.ShapeDtypeStruct((b_pad, 4), jnp.float32),
-        jax.ShapeDtypeStruct((b, k_steps, 1), jnp.int32),
+        jax.ShapeDtypeStruct(outs[0][0].array_shape, jnp.float32),
+        jax.ShapeDtypeStruct(outs[1][0].array_shape, jnp.float32),
+        jax.ShapeDtypeStruct(outs[2][0].array_shape, jnp.int32),
     ]
-    tile = _SMEM_TILE
     call = pl.pallas_call(
         kernel,
         grid=(b,),
         out_shape=out_shape,
-        in_specs=[
-            pl.BlockSpec((1, n_const, s, LANES), lambda i: (i, 0, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, n_carry, s, LANES), lambda i: (i, 0, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((tile, 4), lambda i: (i // tile, 0),
-                         memory_space=pltpu.SMEM),
-            pl.BlockSpec((tile, tab.width), lambda i: (i // tile, 0),
-                         memory_space=pltpu.SMEM),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, n_carry, s, LANES), lambda i: (i, 0, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((tile, 4), lambda i: (i // tile, 0),
-                         memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, k_steps, 1), lambda i: (i, 0, 0),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=[spec(e, m) for e, m in ins],
+        out_specs=[spec(e, m) for e, m in outs],
         interpret=interpret,
     )
     return jax.jit(call)

@@ -378,7 +378,9 @@ class _Env:
             if len(node[2]) != 1:
                 raise CelError("has() takes one argument")
             arg = node[2][0]
-            if arg[0] not in ("field", "index"):
+            # cel-go rejects has(m["x"]) at compile time — only field
+            # selections are testable (use `"x" in m` for maps)
+            if arg[0] != "field":
                 raise CelError("has() needs a field selection")
             try:
                 self.eval(arg)
@@ -464,8 +466,11 @@ class _Env:
         if op == "!=":
             return not self._eq(a, b)
         # ordering: numbers cross-compare (the k8s CEL env enables
-        # cross-type numeric comparisons); strings compare to strings
-        if _is_num(a) and _is_num(b):
+        # cross-type numeric comparisons); strings compare to strings;
+        # bools order bool-to-bool (false < true, CEL standard library)
+        if isinstance(a, bool) and isinstance(b, bool):
+            pass
+        elif _is_num(a) and _is_num(b):
             pass
         elif isinstance(a, str) and isinstance(b, str):
             pass
@@ -489,6 +494,25 @@ class _Env:
             return float(a) == float(b)
         if not _same_kind(a, b):
             return False
+        # typed element equality: Python's [True] == [1] is true, cel-go's
+        # is false (bool vs int) — recurse so members keep CEL typing
+        if isinstance(a, list):
+            return len(a) == len(b) and all(
+                _Env._eq(x, y) for x, y in zip(a, b))
+        if isinstance(a, dict):
+            if len(a) != len(b):
+                return False
+            for k, v in a.items():
+                if k not in b:
+                    return False
+                # typed key check: Python hashes True and 1 to the same
+                # key, but cel-go's {true: x} != {1: x}
+                bk = next(kk for kk in b if kk == k)
+                if isinstance(k, bool) != isinstance(bk, bool):
+                    return False
+                if not _Env._eq(v, b[k]):
+                    return False
+            return True
         return a == b
 
     def _eval_in(self, node):

@@ -20,6 +20,7 @@ subject caps bound the simulation regardless of input.
 
 from __future__ import annotations
 
+import functools
 from typing import Callable, List, Optional, Tuple
 
 MAX_STATES = 2048
@@ -324,11 +325,20 @@ def _closure(nfa: _Nfa, states: set, at_start: bool, at_end: bool) -> set:
     return seen
 
 
+@functools.lru_cache(maxsize=512)
+def _compiled(pattern: str):
+    """Compiled (nfa, start, accept) per pattern.  matches() sits inside
+    the DRA slot-column hot loop over nodes x devices — without this cache
+    every evaluation rebuilt up to MAX_STATES NFA states (mirrors the CEL
+    AST cache in dynamic_resources._compiled)."""
+    return _Compiler(pattern).compile()
+
+
 def search(pattern: str, subject: str) -> bool:
     """RE2-style unanchored partial match (cel-spec matches())."""
     if len(subject) > MAX_SUBJECT:
         raise RegexError("subject too long")
-    nfa, start, accept = _Compiler(pattern).compile()
+    nfa, start, accept = _compiled(pattern)
     n = len(subject)
     current: set = set()
     for pos in range(n + 1):

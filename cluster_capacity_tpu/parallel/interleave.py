@@ -620,6 +620,11 @@ def solve_interleaved_tensor(snapshot: ClusterSnapshot,
             # drift, trust the diagnosis (requeue rather than strand)
             parked_np[ti] = bool(set(counts) &
                                  sweep_mod._add_curable_reasons())
+            if parked_np[ti]:
+                # re-queued after all: the diagnosis just recorded may go
+                # stale (more clones can place, then re-park in-step) — drop
+                # it so the end pass re-diagnoses at the true end state
+                results[solve_idx[ti]] = None
             xc = xc._replace(active=jnp.asarray(active_np),
                              parked_curable=jnp.asarray(parked_np),
                              halt=jnp.asarray(False))

@@ -221,3 +221,46 @@ def test_int64_overflow_is_an_error():
     assert ev("9223372036854775806 + 1") == 2 ** 63 - 1
     dev = _dev()
     assert cel_matches("9223372036854775807 + 1 > 0", dev) is False
+
+
+# --- typed equality / ordering (ADVICE r3: cel-go parity) ------------------
+
+def test_typed_list_equality():
+    # Python's [True] == [1] is true; cel-go's is false (bool vs int)
+    assert ev("[true] == [1]") is False
+    assert ev("[true] == [true]") is True
+    assert ev("[1, 2] == [1, 2]") is True
+    assert ev("[1, 2] == [1, 3]") is False
+    assert ev("[[true]] == [[1]]") is False      # nested
+    assert ev("[1.0] == [1]") is True            # numeric cross-type stays
+
+
+def test_typed_map_equality():
+    assert ev("{'k': true} == {'k': 1}") is False
+    assert ev("{'k': true} == {'k': true}") is True
+    assert ev("{'k': 1} == {'k': 1.0}") is True
+    assert ev("{1: 'a'} == {1.0: 'a'}") is True  # numeric keys cross-type
+    assert ev("{true: 'a'} == {1: 'a'}") is False
+    assert ev("{'a': 1} == {'b': 1}") is False
+    assert ev("{'a': 1, 'b': 2} == {'a': 1}") is False
+
+
+def test_bool_ordering():
+    # CEL standard library defines bool ordering: false < true
+    assert ev("false < true") is True
+    assert ev("true < false") is False
+    assert ev("true <= true") is True
+    assert ev("true > false") is True
+    # but bool does not order against numbers
+    with pytest.raises(cel.CelError):
+        ev("true < 2")
+
+
+def test_has_rejects_index_selection():
+    # cel-go rejects has(m["x"]) at compile time; only field selections
+    dev = _dev(attrs={"x": {"y": 1}})
+    assert cel_matches('has(device.attributes["x"])', dev) is False
+    assert ev("has(m.x)", m={"x": 1}) is True
+    assert ev("has(m.y)", m={"x": 1}) is False
+    with pytest.raises(cel.CelError):
+        ev("has(m['x'])", m={"x": 1})

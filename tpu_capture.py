@@ -1,26 +1,41 @@
 """Opportunistic TPU measurement capture for a flaky accelerator tunnel.
 
 The axon tunnel comes and goes in short windows (round 2: down the whole
-round; round 3: alive for ~2 minutes, then wedged).  This tool makes a
-measurement campaign resilient to that: a cheap subprocess probe, then a
-LADDER of staged measurements — smallest first, each in its own subprocess
-with its own timeout, each appending one JSON line to TPU_CAPTURE.jsonl the
-moment it lands.  A tunnel dying mid-ladder costs only the stage in flight;
-everything captured before it survives.
+round; round 3: alive ~2 minutes, then wedged; 49 dead probes after).  This
+tool makes a measurement campaign resilient to that:
+
+- a cheap subprocess probe on a FAST cadence (30 s period, 45 s timeout —
+  round 3's 150 s period could burn most of a short window before noticing
+  it), then a LADDER of staged measurements, smallest and most-informative
+  first, each in its own subprocess with its own timeout, each appending one
+  JSON line to TPU_CAPTURE.jsonl the moment it lands.
+- a persistent JAX compilation cache (.jax_cache/) shared by every stage:
+  the first live window pays the 20-40 s Mosaic/XLA compiles, every later
+  window (and the driver's own bench.py run) reuses them, so a second
+  2-minute window yields numbers instead of compiles.
+- stage one ("quick") proves the load-bearing facts in a single JAX init:
+  does each kernel family LOWER on real Mosaic (spread fused, IPA fused,
+  batched fused) and do its first 48 placements match the XLA step?  Round
+  3 died discovering one lowering failure; this answers all three within
+  ~2 min of the first live probe.
 
 Usage:
     python tpu_capture.py probe            # 1 probe, exit 0 if alive
     python tpu_capture.py ladder           # run all stages (assumes alive)
-    python tpu_capture.py watch            # loop: probe every N s, ladder
-                                           #   when alive, stop when done
+    python tpu_capture.py watch            # loop: probe, ladder when alive
     BENCH_STAGE=<name> python tpu_capture.py stage   # internal: one stage
 
-Stages (each is also re-runnable standalone):
-    fused_small   fused kernel,  1k nodes,  spread — proves Mosaic compiles
-    fused_10k     fused kernel, 10k nodes, spread — headline-scale steps/s
-    scan_10k      XLA per-step scan, 10k nodes — the non-fused comparison
-    batched_20    batched fused kernel, 20 templates x 1k nodes
-    bench_full    the official bench.py line -> BENCH_tpu_manual.json
+Stages:
+    quick          1k nodes: fused spread + fused IPA + batched, lowering
+                   + 48-step XLA match + small-chunk steps/s, one process
+    fused_10k      fused kernel, 10k nodes, spread — headline steps/s
+    fused_ipa_10k  fused kernel, 10k nodes, IPA — VERDICT r3 weak #2's
+                   missing measurement
+    scan_10k       XLA per-step scan, 10k nodes — the non-fused comparison
+    batched_20     batched fused kernel, 20 templates x 1k nodes
+    sweep_c3       BASELINE config 3 at spec scale: 10k nodes x 100
+                   spread templates through the batched path
+    bench_full     the official bench.py line -> BENCH_tpu_manual.json
 """
 
 from __future__ import annotations
@@ -33,13 +48,28 @@ import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
 OUT = os.path.join(REPO, "TPU_CAPTURE.jsonl")
-PROBE_TIMEOUT = int(os.environ.get("CAPTURE_PROBE_TIMEOUT", "75"))
-WATCH_PERIOD = int(os.environ.get("CAPTURE_WATCH_PERIOD", "150"))
+CACHE_DIR = os.path.join(REPO, ".jax_cache")
+PROBE_TIMEOUT = int(os.environ.get("CAPTURE_PROBE_TIMEOUT", "45"))
+WATCH_PERIOD = int(os.environ.get("CAPTURE_WATCH_PERIOD", "30"))
 WATCH_MAX_S = int(os.environ.get("CAPTURE_WATCH_MAX_S", "28800"))
+# Generation tag: bump when the kernels change materially so the ladder
+# re-measures instead of trusting stale captures.
+GEN = os.environ.get("CAPTURE_GEN", "r4")
+
+
+def _child_env(**extra) -> dict:
+    # ONE cache-env helper for the whole campaign: bench.py owns it, so the
+    # bench subprocesses and the capture stages can never drift onto
+    # different cache dirs (the sharing is the point).
+    import bench
+    env = bench._cache_env(dict(os.environ))
+    env.update(extra)
+    return env
 
 
 def _append(rec: dict) -> None:
     rec["ts"] = time.time()
+    rec.setdefault("gen", GEN)
     with open(OUT, "a") as f:
         f.write(json.dumps(rec) + "\n")
 
@@ -52,7 +82,7 @@ def probe() -> bool:
              "import jax, jax.numpy as jnp; "
              "assert jax.default_backend() not in ('cpu',); "
              "(jnp.ones((256,256)) @ jnp.ones((256,256))).block_until_ready()"],
-            timeout=PROBE_TIMEOUT, capture_output=True)
+            timeout=PROBE_TIMEOUT, capture_output=True, env=_child_env())
         return r.returncode == 0
     except subprocess.TimeoutExpired:
         return False
@@ -62,53 +92,98 @@ def probe() -> bool:
 # stages (run inside a child process on the accelerator)
 # --------------------------------------------------------------------------
 
-def _problem(n_nodes: int):
+def _problem(n_nodes: int, with_spread=True, with_ipa=False):
     os.environ["BENCH_NODES"] = str(n_nodes)
     import bench
     bench.N_NODES = n_nodes
     from cluster_capacity_tpu.engine import simulator as sim
-    pb = bench.build_problem(with_spread=True)
+    pb = bench.build_problem(with_spread=with_spread, with_ipa=with_ipa)
     cfg = sim.static_config(pb)
     consts = sim.build_consts(pb)
     carry = sim._init_carry(pb, consts, pb.profile.seed)
     return pb, cfg, consts, carry
 
 
-def stage_fused_small():
-    return _stage_fused(1024, steps=512)
-
-
-def stage_fused_10k():
-    return _stage_fused(10000, steps=4096)
-
-
-def _stage_fused(n_nodes: int, steps: int):
+def _fused_probe(n_nodes: int, steps: int, with_spread, with_ipa,
+                 verify: bool = True):
+    """Build the fused runner, optionally 48-step cross-check vs XLA, then
+    time `steps` fused steps.  Returns a flat result dict."""
     import jax
     from cluster_capacity_tpu.engine import fused
     from cluster_capacity_tpu.engine import simulator as sim
 
-    pb, cfg, consts, carry = _problem(n_nodes)
+    pb, cfg, consts, carry = _problem(n_nodes, with_spread, with_ipa)
     if not fused.eligible(cfg, pb):
         return {"error": "not kernel-eligible"}
     t0 = time.time()
-    runner = fused.make_runner(cfg, pb, consts, verify_against=None)
+    verify_against = (consts, carry, 48) if verify else None
+    runner = fused.make_runner(cfg, pb, consts,
+                               verify_against=verify_against)
     if runner is None:
-        return {"error": "make_runner returned None"}
+        return {"error": "make_runner returned None (lowering failure or "
+                         "cross-check divergence; see stderr)"}
     st = runner.pack(carry)
     st, ch, _stop = runner.run_packed(st, 64)     # compile + first chunk
     jax.block_until_ready(ch)
     compile_s = time.time() - t0
-    # verify a window against the XLA step before trusting throughput
-    run_chunk = sim._chunk_runner()
-    c2, ref_ch = run_chunk(cfg, consts, carry, 64)
-    ok = bool((jax.numpy.asarray(ref_ch) == ch).all())
     t0 = time.time()
     st, ch, _stop = runner.run_packed(st, steps)
     jax.block_until_ready(ch)
     dt = time.time() - t0
     return {"nodes": n_nodes, "steps": steps, "compile_s": round(compile_s, 2),
-            "steps_per_s": round(steps / dt, 1), "first64_match_xla": ok,
+            "steps_per_s": round(steps / dt, 1),
+            "verified_48_vs_xla": bool(verify),
             "platform": jax.default_backend()}
+
+
+# Family errors that are real ANSWERS (re-running cannot change them), as
+# opposed to transient tunnel deaths that must NOT settle the stage.
+_DETERMINISTIC_ERRORS = ("not kernel-eligible",)
+
+
+def stage_quick():
+    """One JAX init, three kernel families: lower + match + small steps/s.
+    Sub-results are independent — one family failing does not void the
+    others (each sub-dict carries its own error).  Any NON-deterministic
+    family error (a raised exception is usually the tunnel dying, not a
+    property of the kernel) marks the whole stage failed so the next alive
+    window retries it; only 'every family answered' settles the stage."""
+    import jax
+    out = {"platform": jax.default_backend()}
+    try:
+        out["fused_spread_1k"] = _fused_probe(1024, 512, True, False)
+    except Exception as e:
+        out["fused_spread_1k"] = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        out["fused_ipa_1k"] = _fused_probe(1024, 512, False, True)
+    except Exception as e:
+        out["fused_ipa_1k"] = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        os.environ["BENCH_SWEEP_NODES"] = "1000"
+        os.environ["BENCH_SWEEP_TEMPLATES"] = "8"
+        os.environ["BENCH_SWEEP_LIMIT"] = "50"
+        import bench
+        placed, dt, n_t, n_n, batched = bench.bench_sweep("tpu")
+        out["batched_8x1k"] = {"templates": n_t, "placed": placed,
+                               "pps": round(placed / dt, 1),
+                               "batched_fused": batched}
+    except Exception as e:
+        out["batched_8x1k"] = {"error": f"{type(e).__name__}: {e}"}
+    families = ("fused_spread_1k", "fused_ipa_1k", "batched_8x1k")
+    transient = [k for k in families
+                 if "error" in out[k] and not any(
+                     d in out[k]["error"] for d in _DETERMINISTIC_ERRORS)]
+    if transient:
+        out["error"] = f"transient family failures: {','.join(transient)}"
+    return out
+
+
+def stage_fused_10k():
+    return _fused_probe(10000, 4096, True, False)
+
+
+def stage_fused_ipa_10k():
+    return _fused_probe(10000, 4096, False, True)
 
 
 def stage_scan_10k():
@@ -138,8 +213,21 @@ def stage_batched_20():
             "platform": jax.default_backend()}
 
 
+def stage_sweep_c3():
+    """BASELINE config 3 at spec scale: 10k nodes x 100 templates."""
+    import jax
+    os.environ["BENCH_SWEEP_NODES"] = "10000"
+    os.environ["BENCH_SWEEP_TEMPLATES"] = "100"
+    os.environ["BENCH_SWEEP_LIMIT"] = "200"
+    import bench
+    placed, dt, n_t, n_n, batched_fused = bench.bench_sweep("tpu")
+    return {"templates": n_t, "nodes": n_n, "placed": placed,
+            "pps": round(placed / dt, 1), "batched_fused": batched_fused,
+            "platform": jax.default_backend()}
+
+
 def stage_bench_full():
-    env = dict(os.environ)
+    env = _child_env()
     env.pop("BENCH_STAGE", None)
     r = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
                        env=env, capture_output=True, text=True, timeout=3000)
@@ -151,24 +239,29 @@ def stage_bench_full():
 
 
 STAGES = [
-    ("fused_small", stage_fused_small, 420),
+    ("quick", stage_quick, 900),
     ("fused_10k", stage_fused_10k, 600),
+    ("fused_ipa_10k", stage_fused_ipa_10k, 600),
     ("scan_10k", stage_scan_10k, 420),
     ("batched_20", stage_batched_20, 900),
+    ("sweep_c3", stage_sweep_c3, 1500),
     ("bench_full", stage_bench_full, 3100),
 ]
 
 
 def _done_stages() -> set:
-    """Stages that succeeded OR failed deterministically (a stage that
-    returned an {'error': ...} record with a clean exit is a real answer —
-    e.g. 'not kernel-eligible' — and must not block later stages)."""
+    """Stages (of the CURRENT generation) that succeeded OR failed
+    deterministically (an {'error': ...} record with a clean exit is a real
+    answer — e.g. 'not kernel-eligible' — and must not block later
+    stages)."""
     done = set()
     if os.path.exists(OUT):
         for line in open(OUT):
             try:
                 rec = json.loads(line)
             except json.JSONDecodeError:
+                continue
+            if rec.get("gen", "r3") != GEN:
                 continue
             if rec.get("stage") and (rec.get("ok") or rec.get("settled")):
                 done.add(rec["stage"])
@@ -183,24 +276,34 @@ def ladder() -> bool:
             continue
         t0 = time.time()
         settled = False                 # deterministic answer (even if error)
+        rec = {}
+        stderr_tail = ""
         try:
             r = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), "stage"],
-                env=dict(os.environ, BENCH_STAGE=name),
+                env=_child_env(BENCH_STAGE=name),
                 capture_output=True, text=True, timeout=timeout)
+            stderr_tail = (r.stderr or "")[-1200:]
             if r.returncode == 0:
                 rec = json.loads((r.stdout.strip().splitlines() or ["{}"])[-1])
                 settled = True          # the stage ran to completion
             else:
-                rec = {"error": f"rc={r.returncode}",
-                       "stderr": r.stderr[-1200:]}
-        except subprocess.TimeoutExpired:
+                rec = {"error": f"rc={r.returncode}"}
+        except subprocess.TimeoutExpired as e:
             rec = {"error": f"timeout {timeout}s"}   # tunnel likely wedged
+            if e.stderr:
+                stderr_tail = (e.stderr.decode()
+                               if isinstance(e.stderr, bytes)
+                               else e.stderr)[-1200:]
         except Exception as e:
             rec = {"error": f"{type(e).__name__}: {e}"}
         ok = "error" not in rec
-        _append({"stage": name, "ok": ok, "settled": settled,
-                 "wall_s": round(time.time() - t0, 1), **rec})
+        out = {"stage": name, "ok": ok, "settled": settled,
+               "wall_s": round(time.time() - t0, 1), **rec}
+        if stderr_tail and (not ok or "disabled" in stderr_tail
+                            or "refused" in stderr_tail):
+            out["stderr"] = stderr_tail
+        _append(out)
         print(f"[capture] {name}: {'ok' if ok else rec.get('error')}",
               flush=True)
         if ok or settled:
@@ -225,16 +328,22 @@ def main() -> None:
         sys.exit(0 if ladder() else 1)
     # watch
     t_start = time.time()
+    misses = 0
     while time.time() - t_start < WATCH_MAX_S:
         if probe():
+            misses = 0
             _append({"stage": "_probe", "ok": True})
             print("[capture] tunnel alive; running ladder", flush=True)
             if ladder():
                 print("[capture] all stages captured; exiting", flush=True)
                 return
         else:
-            print(f"[capture] tunnel dead at {time.strftime('%H:%M:%S')}",
-                  flush=True)
+            misses += 1
+            # log sparsely on long-dead tunnels (round 3's log was 49
+            # identical lines); first miss and every 10th are enough
+            if misses == 1 or misses % 10 == 0:
+                print(f"[capture] tunnel dead at {time.strftime('%H:%M:%S')}"
+                      f" ({misses} consecutive misses)", flush=True)
         time.sleep(WATCH_PERIOD)
     print("[capture] watch window exhausted", flush=True)
 
