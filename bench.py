@@ -178,18 +178,18 @@ def bench_scan(platform: str, with_spread: bool = False,
 
 
 def bench_sweep(platform: str):
-    """BASELINE config 3: many heterogeneous genpod-style templates WITH
-    PodTopologySpread, solved as group solves against one snapshot — through
-    the batched fused kernel on TPU, the vmapped XLA scan elsewhere."""
+    """BASELINE config 3 at spec scale: 10k nodes x 100 heterogeneous
+    genpod-style templates WITH PodTopologySpread, solved as group solves
+    against one snapshot — through the batched fused kernel on TPU, the
+    vmapped XLA scan elsewhere."""
     from cluster_capacity_tpu.engine import fused
     from cluster_capacity_tpu.models.podspec import default_pod
     from cluster_capacity_tpu.models.snapshot import ClusterSnapshot
     from cluster_capacity_tpu.parallel.sweep import sweep
 
     rng = np.random.RandomState(7)
-    n_nodes = int(os.environ.get("BENCH_SWEEP_NODES", "1000"))
-    n_templates = int(os.environ.get(
-        "BENCH_SWEEP_TEMPLATES", "100" if platform not in ("cpu",) else "20"))
+    n_nodes = int(os.environ.get("BENCH_SWEEP_NODES", "10000"))
+    n_templates = int(os.environ.get("BENCH_SWEEP_TEMPLATES", "100"))
     limit = int(os.environ.get("BENCH_SWEEP_LIMIT", "100"))
 
     snapshot = ClusterSnapshot.from_objects(_make_nodes(
@@ -222,6 +222,81 @@ def bench_sweep(platform: str):
     return placed, dt, n_templates, n_nodes, batched_fused
 
 
+def bench_c5(platform: str):
+    """BASELINE config 5: 50k-node GKE-scale snapshot, FULL default plugin
+    set exercised by the template mix (plain fit/balanced, hard spread,
+    preferred inter-pod anti-affinity, tolerations + preferred node
+    affinity, image locality), 1k-template what-if sweep.  Per-template
+    placement budget is platform-sized: the point of the key is the
+    spec-scale sweep itself and its trend round over round."""
+    from cluster_capacity_tpu.models.podspec import default_pod
+    from cluster_capacity_tpu.models.snapshot import ClusterSnapshot
+    from cluster_capacity_tpu.parallel.sweep import sweep
+
+    rng = np.random.RandomState(11)
+    n_nodes = int(os.environ.get("BENCH_C5_NODES", "50000"))
+    n_templates = int(os.environ.get("BENCH_C5_TEMPLATES", "1000"))
+    limit = int(os.environ.get(
+        "BENCH_C5_LIMIT", "50" if platform not in ("cpu",) else "3"))
+
+    nodes = _make_nodes(n_nodes=n_nodes, n_zones=32,
+                        cpus=(16000, 32000, 64000), mems=(64, 128, 256),
+                        seed=11)
+    for i in range(0, n_nodes, 10):      # 10%: PreferNoSchedule taint
+        nodes[i].setdefault("spec", {})["taints"] = [
+            {"key": "zone-pressure", "value": "high",
+             "effect": "PreferNoSchedule"}]
+    for i in range(0, n_nodes, 20):      # 5%: dedicated NoSchedule taint
+        nodes[i].setdefault("spec", {}).setdefault("taints", []).append(
+            {"key": "dedicated", "value": "batch", "effect": "NoSchedule"})
+    for i in range(0, n_nodes, 4):       # 25% carry the shared app image
+        nodes[i].setdefault("status", {})["images"] = [
+            {"names": ["app:v1"], "sizeBytes": 500 * 1024 * 1024}]
+    snapshot = ClusterSnapshot.from_objects(nodes)
+
+    templates = []
+    for k in range(n_templates):
+        req = {"cpu": f"{int(rng.choice([100, 250, 500]))}m",
+               "memory": str(int(rng.choice([256, 512])) * 1024 ** 2)}
+        pod = {"metadata": {"name": f"t{k}", "labels": {"app": f"t{k}"}},
+               "spec": {"containers": [{"name": "c",
+                                        "resources": {"requests": req}}]}}
+        kind = k % 5
+        if kind == 1:
+            pod["spec"]["topologySpreadConstraints"] = [{
+                "maxSkew": int(rng.choice([4, 8])),
+                "topologyKey": "topology.kubernetes.io/zone",
+                "whenUnsatisfiable": "DoNotSchedule",
+                "labelSelector": {"matchLabels": {"app": f"t{k}"}}}]
+        elif kind == 2:
+            pod["spec"]["affinity"] = {"podAntiAffinity": {
+                "preferredDuringSchedulingIgnoredDuringExecution": [{
+                    "weight": 10, "podAffinityTerm": {
+                        "topologyKey": "kubernetes.io/hostname",
+                        "labelSelector": {
+                            "matchLabels": {"app": f"t{k}"}}}}]}}
+        elif kind == 3:
+            pod["spec"]["tolerations"] = [
+                {"key": "dedicated", "operator": "Equal", "value": "batch",
+                 "effect": "NoSchedule"}]
+            pod["spec"]["affinity"] = {"nodeAffinity": {
+                "preferredDuringSchedulingIgnoredDuringExecution": [{
+                    "weight": 5, "preference": {"matchExpressions": [{
+                        "key": "topology.kubernetes.io/zone",
+                        "operator": "In",
+                        "values": [f"zone-{k % 32}"]}]}}]}}
+        elif kind == 4:
+            pod["spec"]["containers"][0]["image"] = "app:v1"
+        templates.append(default_pod(pod))
+
+    sweep(snapshot, templates, max_limit=limit)       # warmup compile
+    t0 = time.perf_counter()
+    results = sweep(snapshot, templates, max_limit=limit)
+    dt = time.perf_counter() - t0
+    placed = sum(r.placed_count for r in results)
+    return placed, dt, n_templates, n_nodes, limit
+
+
 def _scenario_fast():
     fp_placed, fp_dt = bench_fast_path()
     return {"pps": fp_placed / fp_dt, "dt": fp_dt, "placed": fp_placed}
@@ -241,6 +316,12 @@ def _scenario_sweep():
     placed, dt, n_t, n_n, batched = bench_sweep(_child_platform())
     return {"pps": placed / dt, "templates": n_t, "nodes": n_n,
             "batched_fused": bool(batched)}
+
+
+def _scenario_c5():
+    placed, dt, n_t, n_n, limit = bench_c5(_child_platform())
+    return {"pps": placed / dt, "templates": n_t, "nodes": n_n,
+            "placed": placed, "limit": limit}
 
 
 def _scenario_interleave():
@@ -323,6 +404,7 @@ def _scenario_parity():
 
 _SCENARIOS = {"fast": _scenario_fast, "scan": _scenario_scan,
               "ipa": _scenario_ipa, "sweep": _scenario_sweep,
+              "c5": _scenario_c5,
               "interleave": _scenario_interleave,
               "parity": _scenario_parity}
 
@@ -383,6 +465,8 @@ def main() -> None:
         sc = _run_scenario("scan", False, timeout)
     ipa = _run_scenario("ipa", accel, timeout)
     sw = _run_scenario("sweep", accel, timeout)
+    c5 = _run_scenario("c5", accel,
+                       int(os.environ.get("BENCH_C5_TIMEOUT", "1200")))
     il = _run_scenario("interleave", accel, timeout)
     par = _run_scenario("parity", accel, timeout)
 
@@ -415,6 +499,12 @@ def main() -> None:
         out["sweep_spread_templates"] = sw["templates"]
         out["sweep_spread_nodes"] = sw["nodes"]
         out["sweep_batched_fused_kernel"] = sw["batched_fused"]
+    if c5:
+        out["c5_full_pluginset_placements_per_sec"] = round(c5["pps"], 2)
+        out["c5_templates"] = c5["templates"]
+        out["c5_nodes"] = c5["nodes"]
+        out["c5_placed"] = c5["placed"]
+        out["c5_limit_per_template"] = c5["limit"]
     if il:
         out["interleave_tensor_placements_per_sec"] = round(il["pps"], 2)
         out["interleave_templates"] = il["templates"]

@@ -41,6 +41,22 @@ from . import simulator as sim
 from ..models.snapshot import IDX_CPU, IDX_PODS
 
 
+def _uniform_on_eligible(pb: enc.EncodedProblem, raw: np.ndarray
+                         ) -> Optional[float]:
+    """The single raw value `raw` takes over statically-eligible nodes, or
+    None when it varies.  DefaultNormalizeScore runs over the per-step
+    FEASIBLE set; feasibility only ever shrinks within the static mask, so
+    uniformity there makes the normalized contribution a per-step constant
+    (uniform r>0 -> every node floor(100r/r)=100; all-zero -> max==0
+    branch), which the analytic solve can fold in."""
+    mask = np.asarray(pb.static_mask) & np.asarray(pb.volume_mask)
+    vals = np.asarray(raw)[mask]
+    if vals.size == 0:
+        return 0.0
+    first = float(vals[0])
+    return first if bool((vals == first).all()) else None
+
+
 def eligible(pb: enc.EncodedProblem) -> bool:
     """Static eligibility: every active score must be a pure per-node function
     of that node's own placement count, and every filter static-or-fit."""
@@ -60,11 +76,16 @@ def eligible(pb: enc.EncodedProblem) -> bool:
         return False
     if sim._num_feasible_nodes_to_find(profile, pb.snapshot.num_nodes) > 0:
         return False
-    # TaintToleration normalize is cross-node unless all raw counts are 0
-    # (then every feasible node scores a constant 100).
-    if profile.score_weight("TaintToleration") and pb.taint_raw.any():
+    # TaintToleration / NodeAffinity normalize over the per-step feasible
+    # set — cross-node in general, but a CONSTANT when the raw scores are
+    # uniform over the statically-eligible nodes (VERDICT r3 #6: dedicated
+    # pools where every node carries the same PreferNoSchedule taint, or a
+    # preferred term matching every node, now ride the fast path).
+    if profile.score_weight("TaintToleration") \
+            and _uniform_on_eligible(pb, pb.taint_raw) is None:
         return False
-    if profile.score_weight("NodeAffinity") and pb.node_affinity_active:
+    if profile.score_weight("NodeAffinity") and pb.node_affinity_active \
+            and _uniform_on_eligible(pb, pb.node_affinity_raw) is None:
         return False
     return True
 
@@ -171,8 +192,18 @@ def solve_fast(pb: enc.EncodedProblem, max_limit: int = 0
             req.reshape(n * k_max, -1)).reshape(n, k_max)
         total = total + w * s
 
-    if profile.score_weight("TaintToleration"):
-        total = total + 100.0 * profile.score_weight("TaintToleration")
+    w = profile.score_weight("TaintToleration")
+    if w:
+        # reverse-normalized uniform raw: r>0 -> 100-floor(100r/r)=0 for
+        # every feasible node; r==0 -> the max==0 branch scores 100
+        r = _uniform_on_eligible(pb, pb.taint_raw)
+        total = total + (100.0 if not r else 0.0) * w
+    w = profile.score_weight("NodeAffinity")
+    if w and pb.node_affinity_active:
+        # forward-normalized uniform raw: r>0 -> floor(100r/r)=100;
+        # r==0 -> max==0 leaves the raw 0s untouched
+        r = _uniform_on_eligible(pb, pb.node_affinity_raw)
+        total = total + (100.0 if r else 0.0) * w
     if profile.score_weight("ImageLocality"):
         total = total + consts["il_score"][:, None] * \
             profile.score_weight("ImageLocality")

@@ -590,6 +590,7 @@ def _build_kernel(pk: _Packing, k_steps: int):
             if w:
                 acc = jnp.zeros((s, LANES), dtype=jnp.float32)
                 wsum_n = jnp.zeros((s, LANES), dtype=jnp.float32)
+                rtc = cfg.fit_strategy_type == "RequestedToCapacityRatio"
                 for k2, j in enumerate(cfg.fit_idx):
                     alloc = C[f"alloc{j}"]
                     if cfg.fit_nz[k2]:
@@ -601,7 +602,7 @@ def _build_kernel(pk: _Packing, k_steps: int):
                         per = jnp.where(alloc > 0,
                                         _floor_div(jnp.minimum(req, alloc)
                                                    * 100.0, alloc), 0.0)
-                    elif cfg.fit_strategy_type == "RequestedToCapacityRatio":
+                    elif rtc:
                         from ..ops.node_resources_fit import piecewise_shape
                         util = jnp.where(alloc > 0,
                                          _floor_div(req * 100.0, alloc), 0.0)
@@ -614,9 +615,18 @@ def _build_kernel(pk: _Packing, k_steps: int):
                                                    alloc))
                         per = jnp.where(alloc > 0, per, 0.0)
                     acc = acc + per * meta.fit_w[k2]
-                    # resources with alloc==0 drop their weight per node
-                    wsum_n = wsum_n + jnp.where(alloc > 0, meta.fit_w[k2], 0.0)
-                score = jnp.where(wsum_n > 0, _floor_div(acc, wsum_n), 0.0)
+                    # resources with alloc==0 drop their weight per node;
+                    # RTC also drops score-0 resources and math.Rounds
+                    # (requested_to_capacity_ratio.go:48-56)
+                    counted = (alloc > 0) & (per > 0) if rtc else alloc > 0
+                    wsum_n = wsum_n + jnp.where(counted, meta.fit_w[k2], 0.0)
+                if rtc:
+                    score = jnp.where(
+                        wsum_n > 0,
+                        jnp.floor(acc / jnp.maximum(wsum_n, 1e-30) + 0.5),
+                        0.0)
+                else:
+                    score = jnp.where(wsum_n > 0, _floor_div(acc, wsum_n), 0.0)
                 total = total + w * jnp.where(scorable, score, 0.0)
 
             w = sim._weight(cfg, "NodeResourcesBalancedAllocation")

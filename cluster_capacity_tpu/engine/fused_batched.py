@@ -275,6 +275,7 @@ def _build_batched_kernel(pk: _Packing, tab: ScalarTable, k_steps: int,
             if w:
                 acc = jnp.zeros((s, LANES), dtype=jnp.float32)
                 wsum_n = jnp.zeros((s, LANES), dtype=jnp.float32)
+                rtc = cfg.fit_strategy_type == "RequestedToCapacityRatio"
                 for k2, j in enumerate(cfg.fit_idx):
                     alloc = C[f"alloc{j}"]
                     if cfg.fit_nz[k2]:
@@ -286,7 +287,7 @@ def _build_batched_kernel(pk: _Packing, tab: ScalarTable, k_steps: int,
                         per = jnp.where(alloc > 0,
                                         _floor_div(jnp.minimum(req, alloc)
                                                    * 100.0, alloc), 0.0)
-                    elif cfg.fit_strategy_type == "RequestedToCapacityRatio":
+                    elif rtc:
                         from ..ops.node_resources_fit import piecewise_shape
                         util = jnp.where(alloc > 0,
                                          _floor_div(req * 100.0, alloc), 0.0)
@@ -299,9 +300,18 @@ def _build_batched_kernel(pk: _Packing, tab: ScalarTable, k_steps: int,
                                                    alloc))
                         per = jnp.where(alloc > 0, per, 0.0)
                     acc = acc + per * ts("fit_w", k2)
-                    wsum_n = wsum_n + jnp.where(alloc > 0,
+                    # RTC drops score-0 resources from the weight sum and
+                    # math.Rounds (requested_to_capacity_ratio.go:48-56)
+                    counted = (alloc > 0) & (per > 0) if rtc else alloc > 0
+                    wsum_n = wsum_n + jnp.where(counted,
                                                 ts("fit_w", k2), 0.0)
-                score = jnp.where(wsum_n > 0, _floor_div(acc, wsum_n), 0.0)
+                if rtc:
+                    score = jnp.where(
+                        wsum_n > 0,
+                        jnp.floor(acc / jnp.maximum(wsum_n, 1e-30) + 0.5),
+                        0.0)
+                else:
+                    score = jnp.where(wsum_n > 0, _floor_div(acc, wsum_n), 0.0)
                 total = total + w * jnp.where(scorable, score, 0.0)
 
             w = sim._weight(cfg, "NodeResourcesBalancedAllocation")

@@ -379,11 +379,22 @@ def _fit_score(state: OracleState, i: int, pod: dict,
             rs = _broken_linear(profile.fit_strategy.shape_utilization,
                                 profile.fit_strategy.shape_score,
                                 r * 100 // a)
+            # RTC's mean counts a weight only for score>0 resources and
+            # math.Rounds the quotient (requested_to_capacity_ratio.go:48-56)
+            if rs > 0:
+                node_score += rs * weight
+                weight_sum += weight
+            continue
         else:
             rs = 0 if r > a else (a - r) * 100 // a
         node_score += rs * weight
         weight_sum += weight
-    return node_score // weight_sum if weight_sum else 0
+    if not weight_sum:
+        return 0
+    if profile.fit_strategy.type == "RequestedToCapacityRatio":
+        import math
+        return int(math.floor(node_score / weight_sum + 0.5))
+    return node_score // weight_sum
 
 
 def _broken_linear(shape_utilization, shape_score, p: int) -> int:

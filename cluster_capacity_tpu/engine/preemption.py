@@ -152,9 +152,15 @@ def _pdb_violations(victims: List[dict], pdbs: List[Tuple[dict, int]]) -> int:
     return len(_split_pdb_violations(victims, pdbs)[0])
 
 
+# Clockless analog of GetPodStartTime's time.Now() fallback (util/utils.go:
+# 49-55): a pod that never started counts as starting "now", which is LATER
+# than any recorded startTime.  ISO-8601 strings order lexicographically, so
+# a max sentinel reproduces that ordering without a clock.
+_START_TIME_NOW = "9999-12-31T23:59:59Z"
+
+
 def _pod_start_time(pod: Mapping) -> str:
-    return ((pod.get("status") or {}).get("startTime")) or \
-        ((pod.get("metadata") or {}).get("creationTimestamp")) or ""
+    return ((pod.get("status") or {}).get("startTime")) or _START_TIME_NOW
 
 
 def evaluate(snapshot: ClusterSnapshot, state_pods: List[List[dict]],
@@ -241,19 +247,26 @@ def evaluate(snapshot: ClusterSnapshot, state_pods: List[List[dict]],
     if not candidates:
         return PreemptionOutcome(None, [], message_counts)
 
-    # pickOneNodeForPreemption (preemption.go:624): explicit tournament so
-    # the "latest start time wins" criterion compares strings descending
-    # (ISO-8601 timestamps order lexicographically).
+    # pickOneNodeForPreemption (preemption.go:624): explicit tournament.
+    # Criterion 5 compares each node's EARLIEST start among its
+    # highest-priority victims (GetEarliestPodStartTime, util/utils.go:59-81)
+    # and prefers the node where that earliest start is LATEST; ISO-8601
+    # strings order lexicographically, so string comparison suffices.
     def stats(c):
         i, victims, pdb_viol = c
         priorities = sorted((resolve_priority(p, snapshot.priority_classes)
                              for p in victims), reverse=True)
         highest = priorities[0] if priorities else -(2 ** 31)
-        latest_start = max((_pod_start_time(p) for p in victims
-                            if resolve_priority(p, snapshot.priority_classes)
-                            == highest), default="")
-        return (pdb_viol, highest, sum(priorities), len(victims),
-                latest_start, i)
+        # criterion 3 sums priorities OFFSET by MaxInt32+1 (preemption.go
+        # minSumPrioritiesScoreFunc): the offset folds the victim count in,
+        # so a node with few very-negative-priority victims does not beat a
+        # node with fewer victims of the same priority.
+        sum_offset = sum(p + 2 ** 31 for p in priorities)
+        earliest_start = min((_pod_start_time(p) for p in victims
+                              if resolve_priority(p, snapshot.priority_classes)
+                              == highest), default="")
+        return (pdb_viol, highest, sum_offset, len(victims),
+                earliest_start, i)
 
     def better(a, b) -> bool:
         """True when candidate-stats a beats b."""

@@ -121,6 +121,50 @@ class ClusterSnapshot:
             out.extend(pod_host_ports(pod))
         return out
 
+    # -- per-snapshot host-precompute memo ---------------------------------
+    # What-if sweeps (genpod, BASELINE configs 3/5) encode hundreds of
+    # templates against ONE snapshot; everything below depends only on node
+    # data (or node data + a small canonical pod feature), so recomputing it
+    # per template is O(templates x nodes) pure waste.  Cached arrays are
+    # frozen (writeable=False) — callers copy before mutating.
+
+    def memo(self, key, fn):
+        if not hasattr(self, "_memo"):
+            object.__setattr__(self, "_memo", {})
+        if key not in self._memo:
+            val = fn()
+            if isinstance(val, np.ndarray):
+                val.flags.writeable = False
+            elif isinstance(val, tuple):
+                for v in val:
+                    if isinstance(v, np.ndarray):
+                        v.flags.writeable = False
+            self._memo[key] = val
+        return self._memo[key]
+
+    def topology_domains(self, key: str) -> Tuple[np.ndarray, dict]:
+        """(node_domain i32[N], value→index vocab) for one topology label
+        key, vocabulary in node-axis order — pod-independent, shared by the
+        spread and inter-pod-affinity encoders."""
+        def build():
+            n = self.num_nodes
+            node_domain = np.full(n, -1, dtype=np.int32)
+            vocab: Dict[str, int] = {}
+            for i in range(n):
+                val = self.node_labels(i).get(key)
+                if val is None:
+                    continue
+                if val not in vocab:
+                    vocab[val] = len(vocab)
+                node_domain[i] = vocab[val]
+            return node_domain, vocab
+        return self.memo(("topology_domains", key), build)
+
+    def labels_have_key(self, key: str) -> np.ndarray:
+        """bool[N]: node carries the label key."""
+        return self.memo(("labels_have_key", key),
+                         lambda: self.topology_domains(key)[0] >= 0)
+
     @classmethod
     def from_objects(cls, nodes: Sequence[Mapping],
                      pods: Sequence[Mapping] = (),

@@ -23,6 +23,8 @@ MAX_CONTAINER_THRESHOLD = 1000 * _MB
 
 
 def static_score(snapshot: ClusterSnapshot, pod: dict) -> np.ndarray:
+    """Memoized per (snapshot, image multiset, container count) — identical
+    for every template sharing an image list in a sweep."""
     n = snapshot.num_nodes
     images = [_normalize_image(im) for im in pod_images(pod)]
     spec = pod.get("spec") or {}
@@ -30,8 +32,15 @@ def static_score(snapshot: ClusterSnapshot, pod: dict) -> np.ndarray:
         len(spec.get("initContainers") or [])
     if not images or num_containers == 0 or n == 0:
         return np.zeros(n, dtype=np.float64)
+    return snapshot.memo(("il", tuple(images), num_containers),
+                         lambda: _score(snapshot, images, num_containers))
 
-    node_images = [snapshot.node_images(i) for i in range(n)]
+
+def _score(snapshot: ClusterSnapshot, images, num_containers) -> np.ndarray:
+    n = snapshot.num_nodes
+    node_images = snapshot.memo(
+        ("node_images",),
+        lambda: tuple(snapshot.node_images(i) for i in range(n)))
     num_nodes_with = {im: sum(1 for ni in node_images if im in ni)
                       for im in set(images)}
 

@@ -177,17 +177,11 @@ def encode(snapshot: ClusterSnapshot, pod: Mapping,
         group_of(k)              # appended AFTER own terms: indices stable
 
     g = max(len(keys), 1)
-    # Domain vocab per group.
+    # Domain vocab per group (pod-independent, cached on the snapshot).
     node_domain = np.full((g, n), -1, dtype=np.int32)
     vocabs: List[dict] = [dict() for _ in range(g)]
     for gi, key in enumerate(keys):
-        for i in range(n):
-            val = snapshot.node_labels(i).get(key)
-            if val is None:
-                continue
-            if val not in vocabs[gi]:
-                vocabs[gi][val] = len(vocabs[gi])
-            node_domain[gi, i] = vocabs[gi][val]
+        node_domain[gi], vocabs[gi] = snapshot.topology_domains(key)
     d_max = max(max((len(v) for v in vocabs), default=0), 1)
 
     aff_init = np.zeros((g, d_max), dtype=np.float64)

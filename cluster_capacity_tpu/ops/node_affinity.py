@@ -21,12 +21,26 @@ from ..models.snapshot import ClusterSnapshot
 REASON = "node(s) didn't match Pod's node affinity/selector"
 
 
+def _required_key(spec: dict) -> str:
+    """Canonical key of everything static_mask reads: spec.nodeSelector +
+    requiredDuringScheduling node affinity."""
+    import json
+    affinity = ((spec.get("affinity") or {}).get("nodeAffinity") or {})
+    return json.dumps(
+        [spec.get("nodeSelector"),
+         affinity.get("requiredDuringSchedulingIgnoredDuringExecution")],
+        sort_keys=True)
+
+
 def static_mask(snapshot: ClusterSnapshot, pod: dict) -> np.ndarray:
+    """Memoized per (snapshot, canonical selector+required-affinity) — the
+    sweep use case encodes many templates against one snapshot, and the
+    spread encoder's nodeAffinityPolicy=Honor pass reuses the same mask."""
     spec = pod.get("spec") or {}
-    return np.asarray(
+    return snapshot.memo(("na_mask", _required_key(spec)), lambda: np.asarray(
         [pod_matches_node_selector_and_affinity(spec, snapshot.node_labels(i),
                                                 snapshot.node_names[i])
-         for i in range(snapshot.num_nodes)], dtype=bool)
+         for i in range(snapshot.num_nodes)], dtype=bool))
 
 
 def has_preferred_terms(pod: dict, added_affinity: dict = None) -> bool:
@@ -44,6 +58,7 @@ def static_raw_score(snapshot: ClusterSnapshot, pod: dict,
     """Raw preferred-term score per node; NodeAffinityArgs.addedAffinity
     preferred terms score every pod of the profile on top of the pod's own
     (node_affinity.go:98-106 + :260-285)."""
+    import json
     spec = pod.get("spec") or {}
     added = (added_affinity or {}).get(
         "preferredDuringSchedulingIgnoredDuringExecution")
@@ -57,7 +72,10 @@ def static_raw_score(snapshot: ClusterSnapshot, pod: dict,
             list(own) + list(added)
         affinity["nodeAffinity"] = node_aff
         spec["affinity"] = affinity
-    return np.asarray(
+    merged = ((spec.get("affinity") or {}).get("nodeAffinity") or {}).get(
+        "preferredDuringSchedulingIgnoredDuringExecution")
+    key = ("na_raw", json.dumps(merged, sort_keys=True))
+    return snapshot.memo(key, lambda: np.asarray(
         [preferred_node_affinity_score(spec, snapshot.node_labels(i),
                                        snapshot.node_names[i])
-         for i in range(snapshot.num_nodes)], dtype=np.float64)
+         for i in range(snapshot.num_nodes)], dtype=np.float64))

@@ -115,15 +115,25 @@ def requested_to_capacity_ratio_score(alloc: jnp.ndarray,
                                       shape_utilization: Sequence[float],
                                       shape_score: Sequence[float]) -> jnp.ndarray:
     """requestedToCapacityRatioScorer: per-resource utilization (0-100) mapped
-    through the configured piecewise-linear shape (scores 0-10, scaled x10),
-    then the same weighted integer mean."""
+    through the configured piecewise-linear shape (scores 0-10, scaled x10).
+
+    UNLIKE Least/MostAllocated, the reference's mean here (a) counts a
+    resource's weight only when its shaped score is > 0
+    (`if resourceScore > 0` in buildRequestedToCapacityRatioScorerFunction,
+    requested_to_capacity_ratio.go:48-51) and (b) rounds the quotient with
+    math.Round, not integer division (:56).  Round-half-away == floor(q+0.5)
+    for the non-negative scores here; quotients are ratios of small ints, so
+    a float quotient is either exactly x.5 or >= 1/(2*wsum) away from it —
+    no rounding-boundary hazard in either dtype."""
     valid = alloc > 0
     util = jnp.where(valid, _floor_div(req_with_pod * MAX_NODE_SCORE, alloc), 0.0)
     per_res = jnp.trunc(piecewise_shape(util, shape_utilization, shape_score))
     per_res = jnp.where(valid, per_res, 0.0)
-    wsum = jnp.sum(jnp.where(valid, weights[None, :], 0.0), axis=1)
+    counted = valid & (per_res > 0)
+    wsum = jnp.sum(jnp.where(counted, weights[None, :], 0.0), axis=1)
     total = jnp.sum(per_res * weights[None, :], axis=1)
-    return jnp.where(wsum > 0, _floor_div(total, wsum), 0.0)
+    return jnp.where(wsum > 0,
+                     jnp.floor(total / jnp.maximum(wsum, 1e-30) + 0.5), 0.0)
 
 
 def balanced_allocation_score(alloc: jnp.ndarray,

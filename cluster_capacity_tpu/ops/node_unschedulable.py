@@ -23,10 +23,9 @@ def static_mask(snapshot: ClusterSnapshot, pod: dict) -> np.ndarray:
     tols = pod_tolerations(pod)
     tolerated = any(toleration_tolerates_taint(t, _UNSCHEDULABLE_TAINT)
                     for t in tols)
-    mask = np.ones(snapshot.num_nodes, dtype=bool)
     if tolerated:
-        return mask
-    for i in range(snapshot.num_nodes):
-        if snapshot.node_unschedulable(i):
-            mask[i] = False
-    return mask
+        return np.ones(snapshot.num_nodes, dtype=bool)
+    # pod-independent from here (cordon state): cached per snapshot
+    return snapshot.memo(("unschedulable_mask",), lambda: np.asarray(
+        [not snapshot.node_unschedulable(i)
+         for i in range(snapshot.num_nodes)], dtype=bool))

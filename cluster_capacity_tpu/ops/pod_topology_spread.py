@@ -29,10 +29,7 @@ from typing import List, Mapping, Optional, Sequence, Tuple
 import jax.numpy as jnp
 import numpy as np
 
-from ..models.labels import (find_matching_untolerated_taint,
-                             match_label_selector,
-                             pod_matches_node_selector_and_affinity)
-from ..models.podspec import pod_tolerations
+from ..models.labels import match_label_selector
 from ..models.snapshot import ClusterSnapshot
 
 REASON_CONSTRAINTS = "node(s) didn't match pod topology spread constraints"
@@ -173,47 +170,32 @@ def _encode(snapshot: ClusterSnapshot, pod: Mapping,
     c_num = len(constraints)
     namespace = (pod.get("metadata") or {}).get("namespace") or "default"
     pod_labels = (pod.get("metadata") or {}).get("labels") or {}
-    spec = pod.get("spec") or {}
-    tols = pod_tolerations(pod)
-
     keys = [c.get("topologyKey", "") for c in constraints]
     has_all = np.ones(n, dtype=bool)
-    for i in range(n):
-        labels = snapshot.node_labels(i)
-        has_all[i] = all(k in labels for k in keys)
+    for k in keys:
+        has_all &= snapshot.labels_have_key(k)
 
-    # Domain vocabularies per constraint.
+    # Domain vocabularies per constraint (pod-independent: cached on the
+    # snapshot; sweeps encode hundreds of templates sharing the same keys).
     domains: List[dict] = []
     node_domain = np.full((max(c_num, 1), n), -1, dtype=np.int32)
     countable = np.zeros((max(c_num, 1), n), dtype=bool)
     for ci, c in enumerate(constraints):
-        vocab: dict = {}
-        for i in range(n):
-            labels = snapshot.node_labels(i)
-            val = labels.get(keys[ci])
-            if val is None:
-                continue
-            if val not in vocab:
-                vocab[val] = len(vocab)
-            node_domain[ci, i] = vocab[val]
+        dom, vocab = snapshot.topology_domains(keys[ci])
+        node_domain[ci] = dom
         domains.append(vocab)
         affinity_policy = c.get("nodeAffinityPolicy") or "Honor"
         taints_policy = c.get("nodeTaintsPolicy") or "Ignore"
-        for i in range(n):
-            if require_all:
-                if not has_all[i]:
-                    continue
-            elif node_domain[ci, i] < 0:
-                continue
-            ok = True
-            if affinity_policy == "Honor":
-                ok = pod_matches_node_selector_and_affinity(
-                    spec, snapshot.node_labels(i), snapshot.node_names[i])
-            if ok and taints_policy == "Honor":
-                ok = find_matching_untolerated_taint(
-                    snapshot.node_taints(i), tols,
-                    ("NoSchedule", "NoExecute")) is None
-            countable[ci, i] = ok
+        base = has_all if require_all else (dom >= 0)
+        ok = np.asarray(base).copy()
+        if affinity_policy == "Honor":
+            # same computation as NodeAffinity's Filter mask -> shared memo
+            from .node_affinity import static_mask as _na_mask
+            ok &= _na_mask(snapshot, pod)
+        if taints_policy == "Honor":
+            from .taint_toleration import static_mask_and_reasons as _tt_mask
+            ok &= _tt_mask(snapshot, pod)[0]
+        countable[ci] = ok
 
     d_max = max([len(v) for v in domains], default=0)
     d_max = max(d_max, 1)
@@ -221,9 +203,16 @@ def _encode(snapshot: ClusterSnapshot, pod: Mapping,
     node_existing = np.zeros((max(c_num, 1), n), dtype=np.float64)
     domain_valid = np.zeros((max(c_num, 1), d_max), dtype=bool)
     self_match = np.zeros(max(c_num, 1), dtype=bool)
+    has_pods = snapshot.memo(("has_pods",), lambda: any(
+        len(p) for p in snapshot.pods_by_node))
     for ci, c in enumerate(constraints):
         sel = c.get("labelSelector")
         self_match[ci] = match_label_selector(sel, pod_labels)
+        if not has_pods:
+            # empty cluster: counts stay zero; only domain validity remains
+            doms = node_domain[ci][countable[ci]]
+            domain_valid[ci, np.unique(doms[doms >= 0])] = True
+            continue
         for i in range(n):
             cnt = _count_matching(snapshot.pods_by_node[i], sel, namespace)
             node_existing[ci, i] = cnt

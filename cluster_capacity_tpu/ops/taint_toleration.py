@@ -27,28 +27,41 @@ from ..models.snapshot import ClusterSnapshot
 _DO_NOT_SCHEDULE = ("NoSchedule", "NoExecute")
 
 
+def _tols_key(tols) -> str:
+    import json
+    return json.dumps(tols, sort_keys=True)
+
+
 def static_mask_and_reasons(snapshot: ClusterSnapshot, pod: dict
                             ) -> Tuple[np.ndarray, List[Optional[str]]]:
     """Returns (mask[N], per-node reason string or None).
 
-    Reason strings carry the specific taint, mirroring the Filter message."""
+    Reason strings carry the specific taint, mirroring the Filter message.
+    Memoized per (snapshot, canonical tolerations): sweeps encode many
+    templates, nearly all sharing the same (usually empty) toleration set."""
     tols = pod_tolerations(pod)
-    n = snapshot.num_nodes
-    mask = np.ones(n, dtype=bool)
-    reasons: List[Optional[str]] = [None] * n
-    for i in range(n):
-        taint = find_matching_untolerated_taint(snapshot.node_taints(i), tols,
-                                                _DO_NOT_SCHEDULE)
-        if taint is not None:
-            mask[i] = False
-            reasons[i] = ("node(s) had untolerated taint "
-                          f"{{{taint.get('key', '')}: {taint.get('value', '')}}}")
-    return mask, reasons
+
+    def build():
+        n = snapshot.num_nodes
+        mask = np.ones(n, dtype=bool)
+        reasons: List[Optional[str]] = [None] * n
+        for i in range(n):
+            taint = find_matching_untolerated_taint(
+                snapshot.node_taints(i), tols, _DO_NOT_SCHEDULE)
+            if taint is not None:
+                mask[i] = False
+                reasons[i] = (
+                    "node(s) had untolerated taint "
+                    f"{{{taint.get('key', '')}: {taint.get('value', '')}}}")
+        return mask, tuple(reasons)
+
+    mask, reasons = snapshot.memo(("taint_mask", _tols_key(tols)), build)
+    return mask, list(reasons)
 
 
 def static_raw_score(snapshot: ClusterSnapshot, pod: dict) -> np.ndarray:
     """Raw score = count of intolerable PreferNoSchedule taints per node."""
     tols = pod_tolerations(pod)
-    return np.asarray(
+    return snapshot.memo(("taint_raw", _tols_key(tols)), lambda: np.asarray(
         [count_intolerable_prefer_no_schedule(snapshot.node_taints(i), tols)
-         for i in range(snapshot.num_nodes)], dtype=np.float64)
+         for i in range(snapshot.num_nodes)], dtype=np.float64))

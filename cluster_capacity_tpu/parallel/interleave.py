@@ -216,36 +216,47 @@ def union_topology_keys(templates: Sequence[dict]) -> List[str]:
 # eligibility
 # --------------------------------------------------------------------------
 
-def _preemption_impossible(snapshot: ClusterSnapshot,
-                           templates: Sequence[dict]) -> bool:
-    """True when DefaultPreemption can never find a victim: all templates
-    share one priority and every existing pod is at or above it (victims
-    must be STRICTLY lower than the preemptor, preemption.go:200-205)."""
+def _tier_ranks(snapshot: ClusterSnapshot,
+                templates: Sequence[dict]) -> np.ndarray:
+    """Dense priority rank per template (0 = highest tier) for the device
+    pop key; equal priorities share a rank (FIFO within the tier)."""
     from ..engine.preemption import resolve_priority
-    prios = {resolve_priority(t, snapshot.priority_classes)
-             for t in templates}
-    if len(prios) > 1:
-        return False
-    p = prios.pop() if prios else 0
+    prios = [resolve_priority(t, snapshot.priority_classes)
+             for t in templates]
+    order = sorted(set(prios), reverse=True)
+    rank_of = {p: r for r, p in enumerate(order)}
+    return np.asarray([rank_of[p] for p in prios], dtype=np.int32)
+
+
+def _preempt_maybe(snapshot: ClusterSnapshot,
+                   templates: Sequence[dict]) -> np.ndarray:
+    """maybe[t]: could DefaultPreemption EVER find a victim for template t —
+    some existing pod or some other template's clones sit STRICTLY below
+    t's priority (preemption.go:200-205)?  Conservative and static: the
+    pod set only loses members below t (evictions) and gains clones at
+    known template priorities."""
+    from ..engine.preemption import resolve_priority
+    prios = [resolve_priority(t, snapshot.priority_classes)
+             for t in templates]
+    floor = min(prios) if prios else 0
     for plist in snapshot.pods_by_node:
         for pod in plist:
-            if resolve_priority(pod, snapshot.priority_classes) < p:
-                return False
-    return True
+            floor = min(floor, resolve_priority(pod, snapshot.priority_classes))
+    return np.asarray([p > floor for p in prios], dtype=bool)
 
 
 def eligible_profile(snapshot: ClusterSnapshot, templates: Sequence[dict],
                      profile: SchedulerProfile) -> Optional[str]:
-    """Profile/priority gates checkable BEFORE the O(T*N) encode pass."""
+    """Profile gates checkable BEFORE the O(T*N) encode pass.  Priority
+    tiers and preemption are handled natively (tier-ranked pops on device;
+    victim selection as a rare host event between chunks), so they no
+    longer force the object path (VERDICT r3 #5)."""
     if not profile.deterministic:
         return "non-deterministic tie-break"
     if profile.extenders:
         return "extenders are host-synchronous"
     if profile.include_preemption_message:
         return "preemption message formatting needs the object path"
-    if "DefaultPreemption" in profile.post_filters and \
-            not _preemption_impossible(snapshot, templates):
-        return "preemption pressure (priorities differ)"
     return None
 
 
@@ -319,7 +330,15 @@ def _xstep(cfg: sim.StaticConfig, sconsts, xconsts, xc: XCarry):
     t_n = xc.k.shape[0]
 
     inf = jnp.asarray(2 ** 30, jnp.int32)
-    t = jnp.argmin(jnp.where(xc.active, xc.last_seq, inf)).astype(jnp.int32)
+    # PrioritySort pop (scheduling_queue.go activeQ + priority_sort.go):
+    # highest priority tier first (tier_rank 0 = highest), FIFO by seq
+    # within the tier — two reductions instead of one composite key so big
+    # budgets can't overflow int32.
+    rank = xconsts["tier_rank"]
+    rank_masked = jnp.where(xc.active, rank, inf)
+    rmin = jnp.min(rank_masked)
+    t = jnp.argmin(jnp.where(xc.active & (rank == rmin), xc.last_seq, inf)
+                   ).astype(jnp.int32)
     any_active = jnp.any(xc.active)
     live = any_active & ~xc.halt & (xc.quota > 0)
 
@@ -371,8 +390,13 @@ def _xstep(cfg: sim.StaticConfig, sconsts, xconsts, xc: XCarry):
     curable_node = _idx(xconsts["static_ports_fail"], t) | \
         (base_ok & (sm | ~s_ok | ipa_fail))
     curable_now = jnp.any(curable_node)
-    repark = fails & curable_now
-    halts = fails & ~curable_now
+    # A template that could preempt (some pod in the system sits strictly
+    # below its priority) must halt on EVERY failure: the object path runs
+    # the DefaultPreemption PostFilter before parking, and only the host
+    # can evaluate victims — in-step re-parking would skip preemption.
+    pm = _idx(xconsts["preempt_maybe"], t)
+    repark = fails & curable_now & ~pm
+    halts = fails & (~curable_now | pm)
     gate = do.astype(dt)
     onehot_t = jnp.arange(t_n, dtype=jnp.int32) == t
 
@@ -518,54 +542,86 @@ def solve_interleaved_tensor(snapshot: ClusterSnapshot,
     if not solve_idx:
         return results  # type: ignore[return-value]
 
-    pbs, cfg, dnh = sweep_mod._pad_group([pbs_all[i] for i in solve_idx])
-    t_n = len(pbs)
-    consts_list = [sim.build_consts(pb, ss_dnh_min=dnh) for pb in pbs]
-    sconsts = {k: jnp.stack([c[k] for c in consts_list])
-               for k in consts_list[0]}
+    solve_templates = [templates[i] for i in solve_idx]
+    t_n = len(solve_idx)
+    snap_cur = snapshot
+    tier_rank = _tier_ranks(snapshot, solve_templates)
+    maybe = _preempt_maybe(snapshot, solve_templates)
+    preempt_on = "DefaultPreemption" in profile.post_filters
+    preempt_capable = bool(preempt_on and maybe.any())
+    preempt_budget = 10 * t_n + 100       # eviction valve (sweep_interleaved)
 
-    dt = consts_list[0]["allocatable"].dtype
+    def encode_group(snap):
+        """(pbs, cfg, dnh, consts_list, sconsts, xconsts) for the CURRENT
+        snapshot — rebuilt after every eviction round, exactly like the
+        object path's rebuild_after_eviction + re-verdict pass."""
+        pbs_new = [enc.encode_problem(snap, t, profile,
+                                      ipa_extra_keys=extra_keys)
+                   for t in solve_templates]
+        pbs, cfg, dnh = sweep_mod._pad_group(pbs_new)
+        consts_list = [sim.build_consts(pb, ss_dnh_min=dnh) for pb in pbs]
+        sconsts = {k: jnp.stack([c[k] for c in consts_list])
+                   for k in consts_list[0]}
+        dt = consts_list[0]["allocatable"].dtype
+        f = lambda a: jnp.asarray(a, dtype=dt)
+        xconsts = {
+            "sh_xinc": f(_spread_xinc(pbs, "spread_hard")),
+            "ss_xinc": f(_spread_xinc(pbs, "spread_soft")),
+            # static port conflicts vs EXISTING pods carry the curable
+            # ports reason string (diagnose attributes static codes first)
+            "static_ports_fail": jnp.stack([
+                jnp.asarray(np.asarray(pb.static_code) == enc.CODE_PORTS)
+                for pb in pbs]),
+            "tier_rank": jnp.asarray(tier_rank),
+            "preempt_maybe": jnp.asarray(
+                maybe if preempt_on else np.zeros(t_n, dtype=bool)),
+            **{k: f(v) for k, v in _ipa_xinc(pbs).items()},
+        }
+        return pbs, cfg, dnh, consts_list, sconsts, xconsts, dt
+
+    pbs, cfg, dnh, consts_list, sconsts, xconsts, dt = encode_group(snap_cur)
     f = lambda a: jnp.asarray(a, dtype=dt)
-    xconsts = {
-        "sh_xinc": f(_spread_xinc(pbs, "spread_hard")),
-        "ss_xinc": f(_spread_xinc(pbs, "spread_soft")),
-        # static port conflicts vs EXISTING pods carry the curable ports
-        # reason string (diagnose attributes static codes first)
-        "static_ports_fail": jnp.stack([
-            jnp.asarray(np.asarray(pb.static_code) == enc.CODE_PORTS)
-            for pb in pbs]),
-        **{k: f(v) for k, v in _ipa_xinc(pbs).items()},
-    }
 
-    g = pbs[0].ipa.node_domain.shape[0]
-    cs = pbs[0].spread_soft.node_domain.shape[0]
-    xc = XCarry(
-        requested=f(pbs[0].init_requested),
-        nonzero=f(pbs[0].init_nonzero),
-        placed=jnp.zeros(n, dtype=jnp.int32),
-        sh_cnt=sconsts["sh_cnt_init"],
-        ss_cnt=sconsts["ss_cnt_init"],
-        ssh_cnt=jnp.zeros((t_n, cs, n), dtype=dt),
-        aff_cnt=jnp.zeros((t_n, g, n), dtype=dt),
-        anti_cnt=jnp.zeros((t_n, g, n), dtype=dt),
-        eanti_cnt=jnp.zeros((t_n, g, n), dtype=dt),
-        pref_cnt=jnp.zeros((t_n, g, n), dtype=dt),
-        aff_total=jnp.zeros(t_n, dtype=dt),
-        k=jnp.zeros(t_n, dtype=jnp.int32),
-        active=jnp.ones(t_n, dtype=bool),
-        parked_curable=jnp.zeros(t_n, dtype=bool),
-        last_seq=jnp.arange(t_n, dtype=jnp.int32),
-        next_start=jnp.zeros(t_n, dtype=jnp.int32),
-        seq_next=jnp.asarray(t_n, jnp.int32),
-        quota=jnp.asarray(0, jnp.int32),
-        halt=jnp.asarray(False),
-        halt_ti=jnp.asarray(0, jnp.int32))
+    def fresh_xcarry(k_counts, active_np, parked_np, last_seq_np,
+                     next_start_np, seq_next_v, quota_v):
+        g = pbs[0].ipa.node_domain.shape[0]
+        cs = pbs[0].spread_soft.node_domain.shape[0]
+        return XCarry(
+            requested=f(pbs[0].init_requested),
+            nonzero=f(pbs[0].init_nonzero),
+            placed=jnp.zeros(n, dtype=jnp.int32),
+            sh_cnt=sconsts["sh_cnt_init"],
+            ss_cnt=sconsts["ss_cnt_init"],
+            ssh_cnt=jnp.zeros((t_n, cs, n), dtype=dt),
+            aff_cnt=jnp.zeros((t_n, g, n), dtype=dt),
+            anti_cnt=jnp.zeros((t_n, g, n), dtype=dt),
+            eanti_cnt=jnp.zeros((t_n, g, n), dtype=dt),
+            pref_cnt=jnp.zeros((t_n, g, n), dtype=dt),
+            aff_total=jnp.zeros(t_n, dtype=dt),
+            k=jnp.asarray(k_counts, dtype=jnp.int32),
+            active=jnp.asarray(active_np),
+            parked_curable=jnp.asarray(parked_np),
+            last_seq=jnp.asarray(last_seq_np, dtype=jnp.int32),
+            next_start=jnp.asarray(next_start_np, dtype=jnp.int32),
+            seq_next=jnp.asarray(seq_next_v, jnp.int32),
+            quota=jnp.asarray(quota_v, jnp.int32),
+            halt=jnp.asarray(False),
+            halt_ti=jnp.asarray(0, jnp.int32))
 
-    budget = min(sum(pb.max_steps_hint for pb in pbs) + t_n + 1,
-                 sim._DEFAULT_UNLIMITED_CAP)
-    if max_total:
-        budget = min(budget, max_total)
-    xc = xc._replace(quota=jnp.asarray(budget, jnp.int32))
+    def hint_budget(total_done: int) -> int:
+        """Step allowance from NOW: the fit-bound hints of the CURRENT pbs
+        (evictions free capacity, so this is recomputed per rebuild — the
+        pre-eviction hint would under-budget the preemptor's gains)."""
+        b = min(total_done + sum(pb.max_steps_hint for pb in pbs) + t_n + 1,
+                sim._DEFAULT_UNLIMITED_CAP)
+        if max_total:
+            b = min(b, max_total)
+        return b
+
+    budget = hint_budget(0)
+    xc = fresh_xcarry(np.zeros(t_n), np.ones(t_n, dtype=bool),
+                      np.zeros(t_n, dtype=bool), np.arange(t_n),
+                      np.zeros(t_n), t_n, budget)
 
     def view_of(ti: int):
         return sim.Carry(
@@ -589,12 +645,95 @@ def solve_interleaved_tensor(snapshot: ClusterSnapshot,
 
     run = _xchunk_runner()
     placements: List[List[int]] = [[] for _ in pbs]
+    # Host object mirror for preemption rounds: the current truth of every
+    # node's pod roster (snapshot pods + live clone dicts).  Clone dicts are
+    # created ONCE at placement time (make_clone mints a fresh uid) so
+    # victim identity is stable across preemption rounds.
+    pods_by_node_cur = [list(p) for p in snapshot.pods_by_node] \
+        if preempt_capable else None
+    # nodes whose roster differs from snap_cur's arrays (clones placed
+    # since the last rebuild + eviction sites) — with_pods_by_node only
+    # recomputes THESE rows, so missing one resurrects freed/consumed
+    # capacity
+    dirty_nodes: set = set()
+    front_seq = -1
     total = 0
     steps_done = 0
     # backstop far above any real run: per placement, every curable-parked
-    # template may take one no-op retry pop, and each of the <= t_n halts
-    # no-ops the remainder of its chunk
-    max_steps = (budget + 1) * (t_n + 2) + CHUNK * (t_n + 2)
+    # template may take one no-op retry pop, each of the <= t_n halts
+    # no-ops the remainder of its chunk, and every eviction round can
+    # requeue the whole field once
+    max_steps = (budget + 1) * (t_n + 2) + CHUNK * (t_n + 2) \
+        + (preempt_budget + 1) * (t_n + CHUNK)
+
+    def try_preempt(ti: int) -> bool:
+        """DefaultPreemption PostFilter for template ti's halted clone
+        (sweep_interleaved's preemption branch, host-side): evaluate
+        victims on the CURRENT truth, evict, rebuild the device engine
+        from the post-eviction snapshot, requeue every parked template
+        (pod-DELETE event), and put the preemptor at the front of its
+        tier.  Returns True when an eviction happened."""
+        nonlocal snap_cur, pbs, cfg, dnh, consts_list, sconsts, xconsts, \
+            xc, preempt_budget, front_seq, budget
+        from ..engine.preemption import evaluate as preempt_evaluate
+        from ..engine.preemption import victim_matcher
+        from ..models import snapshot as snapshot_mod
+
+        outcome = preempt_evaluate(snap_cur, pods_by_node_cur,
+                                   solve_templates[ti], profile)
+        if not (outcome.succeeded and outcome.victims):
+            return False
+        preempt_budget -= 1
+        is_victim = victim_matcher(outcome.victims)
+        for i in range(n):
+            kept = [p for p in pods_by_node_cur[i] if not is_victim(p)]
+            if len(kept) != len(pods_by_node_cur[i]):
+                dirty_nodes.add(i)
+                pods_by_node_cur[i] = kept
+        next_snap = snapshot_mod.with_pods_by_node(
+            snap_cur, pods_by_node_cur, sorted(dirty_nodes))
+        dirty_nodes.clear()
+        if next_snap is None:
+            next_snap = ClusterSnapshot.from_objects(
+                snap_cur.nodes,
+                [p for plist in pods_by_node_cur for p in plist],
+                sort_nodes=False, use_native=False,
+                **{k: getattr(snap_cur, k)
+                   for k in snapshot_mod.OBJECT_FIELDS})
+        snap_cur = next_snap
+
+        # carry the queue state across the rebuild
+        active_np = np.asarray(xc.active).copy()
+        parked_np = np.asarray(xc.parked_curable).copy()
+        last_seq_np = np.asarray(xc.last_seq).copy()
+        next_start_np = np.asarray(xc.next_start).copy()
+        seq_next_v = int(np.asarray(xc.seq_next))
+        # pod-DELETE reactivates EVERY parked template, in index order
+        # (scheduling_queue.go:177-193; sweep_interleaved requeue())
+        for tj in range(t_n):
+            host_parked = (not active_np[tj]) or parked_np[tj]
+            if tj != ti and host_parked:
+                active_np[tj] = True
+                parked_np[tj] = False
+                results[solve_idx[tj]] = None
+                last_seq_np[tj] = seq_next_v
+                seq_next_v += 1
+        # the preemptor retries FIRST within its tier (nominatedNodeName
+        # reservation analog) with a fresh sampling cycle
+        active_np[ti] = True
+        parked_np[ti] = False
+        results[solve_idx[ti]] = None
+        last_seq_np[ti] = front_seq
+        front_seq -= 1
+        next_start_np[ti] = 0
+
+        pbs, cfg, dnh, consts_list, sconsts, xconsts, _dt = \
+            encode_group(snap_cur)
+        budget = hint_budget(total)
+        xc = fresh_xcarry([len(p) for p in placements], active_np,
+                          parked_np, last_seq_np, next_start_np,
+                          seq_next_v, budget - total)
+        return True
 
     while steps_done < max_steps:
         if not bool(np.asarray(xc.active).any()) or total >= budget:
@@ -606,12 +745,20 @@ def solve_interleaved_tensor(snapshot: ClusterSnapshot,
             if t_i >= 0:
                 placements[t_i].append(ch_i)
                 total += 1
+                if preempt_capable:
+                    clone = ps.make_clone(solve_templates[t_i],
+                                          len(placements[t_i]) - 1)
+                    clone["spec"]["nodeName"] = snapshot.node_names[ch_i]
+                    pods_by_node_cur[ch_i].append(clone)
+                    dirty_nodes.add(ch_i)
         steps_done += CHUNK
         if bool(np.asarray(xc.halt)):
-            # a NON-curable park: diagnose with the state at exactly this
-            # moment (in-step no-ops preserved it) and retire the template
-            # permanently — no event in scope can requeue it.
             ti = int(np.asarray(xc.halt_ti))
+            if preempt_capable and maybe[ti] and preempt_budget > 0 \
+                    and try_preempt(ti):
+                continue
+            # preemption impossible/failed: diagnose with the state at
+            # exactly this moment (in-step no-ops preserved it) and park.
             counts = park_result(ti)
             active_np = np.asarray(xc.active).copy()
             parked_np = np.asarray(xc.parked_curable).copy()

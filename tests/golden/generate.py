@@ -28,16 +28,30 @@ REDUCED = {"profile": {"score_weights": {"NodeResourcesFit": 1}},
 
 
 def scenario(name, description, derivation, nodes, pod, expected,
-             profile_block=PARITY, max_limit=0):
+             profile_block=PARITY, max_limit=0, pods=None):
     data = {"description": description, "derivation": derivation}
     data.update(profile_block)
-    data.update({"max_limit": max_limit, "snapshot": {"nodes": nodes},
+    snapshot = {"nodes": nodes}
+    if pods:
+        snapshot["pods"] = pods
+    data.update({"max_limit": max_limit, "snapshot": snapshot,
                  "pod": pod, "expected": expected})
     path = os.path.join(HERE, f"{name}.json")
     with open(path, "w") as f:
         json.dump(data, f, indent=2)
         f.write("\n")
     print(f"wrote {path}")
+
+
+def victim(name, node, milli_cpu, priority, start_time=None):
+    """Existing lower-priority pod occupying a node (preemption fodder)."""
+    pod = {"metadata": {"name": name, "namespace": "default"},
+           "spec": {"nodeName": node, "priority": priority,
+                    "containers": [{"name": "c", "resources": {
+                        "requests": {"cpu": f"{milli_cpu}m"}}}]}}
+    if start_time:
+        pod["status"] = {"startTime": start_time}
+    return pod
 
 
 def main():
@@ -209,6 +223,221 @@ def main():
          "fail_message": "0/3 nodes are available: 3 Too many pods."},
         profile_block={"profile": {"score_weights": {"InterPodAffinity": 2}},
                        "parity": True})
+
+    # --- round-4 corpus: hand-derived where same-author risk was highest ---
+
+    scenario(
+        "rtc_binpack_sequence",
+        "hand-derived RequestedToCapacityRatio bin-packing trace "
+        "(requested_to_capacity_ratio.go:32-58 + shape_score.go:40-53, "
+        "shape 0->0,100->10): per-placement score_node(k) = "
+        "math.Round(mean over score>0 resources of trunc-interpolated "
+        "utilization x10).  n0 (1000m/1GB): score(k)=round(17.5(k+1)) = "
+        "18,35,53,70 (the k=0 and k=2 values are exact .5 halves -> Round "
+        "half-up).  n1 (2000m/1GB): round((floor(12.5(k+1))+10(k+1))/2) = "
+        "11,23,34,45,... n0 always wins until its cpu cap of 4, then n1 "
+        "fills to its cap of 8; both end Insufficient cpu",
+        "manual-arithmetic",
+        [build_test_node("n0", 1000, 10 ** 9, 20),
+         build_test_node("n1", 2000, 10 ** 9, 20)],
+        {"metadata": {"name": "rtc"}, "spec": {"containers": [
+            {"name": "c", "resources": {"requests": {
+                "cpu": "250m", "memory": str(10 ** 8)}}}]}},
+        {"placed_count": 12,
+         "placements": ["n0"] * 4 + ["n1"] * 8,
+         "fail_type": "Unschedulable",
+         "fail_message": "0/2 nodes are available: 2 Insufficient cpu."},
+        profile_block={"profile": {
+            "score_weights": {"NodeResourcesFit": 1},
+            "fit_strategy": {"type": "RequestedToCapacityRatio",
+                             "resources": [["cpu", 1], ["memory", 1]],
+                             "shape_utilization": [0, 100],
+                             "shape_score": [0, 10]}},
+            "parity": True})
+
+    scenario(
+        "rtc_zero_score_weight_drop",
+        "discriminates RTC's mean from Least/MostAllocated's "
+        "(requested_to_capacity_ratio.go:48-56: a resource's weight counts "
+        "ONLY when its shaped score > 0, and the quotient is math.Rounded). "
+        "Shape 50->0,100->10: shaped(p)=trunc(2(p-50)) above 50, else 0. "
+        "nodeA (1000m/20MB): cpu util 30 -> 0 (weight dropped), mem util "
+        "65 -> 30; score = round(30/1) = 30.  nodeB (500m/20MB): cpu util "
+        "60 -> 20, mem 65 -> 30; score = round(50/2) = 25.  A(30) > B(25) "
+        "-> first placement on nodeA.  (Including zero-score weights would "
+        "give A floor(30/2)=15 < B 25 and flip the choice.)",
+        "manual-arithmetic",
+        [build_test_node("nodeA", 1000, 2 * 10 ** 7, 10),
+         build_test_node("nodeB", 500, 2 * 10 ** 7, 10)],
+        {"metadata": {"name": "rtc2"}, "spec": {"containers": [
+            {"name": "c", "resources": {"requests": {
+                "cpu": "300m", "memory": str(13 * 10 ** 6)}}}]}},
+        {"placed_count": 1, "placements": ["nodeA"],
+         "fail_type": "LimitReached"},
+        profile_block={"profile": {
+            "score_weights": {"NodeResourcesFit": 1},
+            "fit_strategy": {"type": "RequestedToCapacityRatio",
+                             "resources": [["cpu", 1], ["memory", 1]],
+                             "shape_utilization": [50, 100],
+                             "shape_score": [0, 10]}},
+            "parity": True},
+        max_limit=1)
+
+    zone_nodes = [
+        build_test_node("n0", 10000, 10 ** 12, 50,
+                        labels={"kubernetes.io/hostname": "n0",
+                                "topology.kubernetes.io/zone": "z0"}),
+        build_test_node("n1", 10000, 10 ** 12, 50,
+                        labels={"kubernetes.io/hostname": "n1",
+                                "topology.kubernetes.io/zone": "z1"}),
+    ]
+
+    def spread_pod(min_domains):
+        return {"metadata": {"name": "md", "labels": {"app": "md"}},
+                "spec": {"containers": [{"name": "c", "resources": {
+                    "requests": {"cpu": "100m"}}}],
+                    "topologySpreadConstraints": [{
+                        "maxSkew": 1,
+                        "topologyKey": "topology.kubernetes.io/zone",
+                        "whenUnsatisfiable": "DoNotSchedule",
+                        "minDomains": min_domains,
+                        "labelSelector": {"matchLabels": {"app": "md"}}}]}}
+
+    scenario(
+        "min_domains_unsatisfied",
+        "minDomains edge (filtering.go:56-69): 2 zones < minDomains=3 "
+        "forces minMatchNum=0, so a zone with ANY match has skew "
+        "count+1-0 > maxSkew=1 and blocks.  Trace: (0,0) both pass, tie "
+        "-> n0; (1,0) n0 skew 2 blocked -> n1; (1,1) both blocked -> "
+        "Unschedulable with the spread FitError on both nodes",
+        "manual-arithmetic",
+        zone_nodes, spread_pod(3),
+        {"placed_count": 2, "placements": ["n0", "n1"],
+         "fail_type": "Unschedulable",
+         "fail_message": "0/2 nodes are available: 2 node(s) didn't match "
+                         "pod topology spread constraints."})
+
+    scenario(
+        "min_domains_satisfied_alternation",
+        "same cluster with minDomains=2 == domain count: minMatchNum is "
+        "the true global min (filtering.go:56-69), so the skew rule "
+        "count+1-min <= 1 forces strict zone alternation: "
+        "(0,0)->n0, (1,0) n0 skew 2 -> n1, (1,1) min=1 tie -> n0, "
+        "(2,1) -> n1, (2,2) -> n0, (3,2) -> n1; limit 6",
+        "manual-arithmetic",
+        zone_nodes, spread_pod(2),
+        {"placed_count": 6,
+         "placements": ["n0", "n1", "n0", "n1", "n0", "n1"],
+         "fail_type": "LimitReached"},
+        max_limit=6)
+
+    preempt_nodes = [build_test_node(f"n{i}", 1000, 10 ** 9, 10)
+                     for i in range(3)]
+
+    def preemptor(cpu_m):
+        return {"metadata": {"name": "hi", "labels": {"app": "hi"}},
+                "spec": {"priority": 100, "containers": [
+                    {"name": "c", "resources": {"requests": {
+                        "cpu": f"{cpu_m}m"}}}]}}
+
+    scenario(
+        "preempt_lowest_victim_priority",
+        "pickOneNodeForPreemption criterion 2 (preemption.go:643-648: "
+        "minimum highest-priority victim wins).  All 3 nodes are cpu-full "
+        "with one victim each (priorities 50/10/30); each clone evicts the "
+        "node whose victim priority is lowest among remaining candidates: "
+        "n1 (10), then n2 (30), then n0 (50); the 4th clone finds no "
+        "victims (placed clones are equal priority) -> Unschedulable",
+        "manual-arithmetic",
+        preempt_nodes, preemptor(800),
+        {"placed_count": 3, "placements": ["n1", "n2", "n0"],
+         "fail_type": "Unschedulable",
+         "fail_message": "0/3 nodes are available: 3 Insufficient cpu."},
+        pods=[victim("v0", "n0", 1000, 50),
+              victim("v1", "n1", 1000, 10),
+              victim("v2", "n2", 1000, 30)])
+
+    scenario(
+        "preempt_sum_of_priorities",
+        "criterion 3 (preemption.go:649-661: smallest victim priority sum "
+        "after the MaxInt32+1 offset).  n0 victims 20+20, n1 victims "
+        "20+10, n2 victim 30; the 900m preemptor needs both 500m victims "
+        "gone (reprieve re-add fails: 500+900 > 1000).  Criterion 2 ties "
+        "n0/n1 at highest=20 and drops n2 (30); criterion 3 picks n1 "
+        "(30+2off < 40+2off).  Then n0 (highest 20 < 30), then n2",
+        "manual-arithmetic",
+        preempt_nodes, preemptor(900),
+        {"placed_count": 3, "placements": ["n1", "n0", "n2"],
+         "fail_type": "Unschedulable",
+         "fail_message": "0/3 nodes are available: 3 Insufficient cpu."},
+        pods=[victim("a", "n0", 500, 20), victim("b", "n0", 500, 20),
+              victim("c", "n1", 500, 20), victim("d", "n1", 500, 10)] +
+             [victim("e", "n2", 1000, 30)])
+
+    scenario(
+        "preempt_negative_priority_offset",
+        "criterion 3's MaxInt32+1 offset makes the sum encode the victim "
+        "count (preemption.go:652-656): n0 victims (0, -2^30, -2^30) sum "
+        "to 3off - 2^30x2 = 2^32; n1 victims (0, 0) sum to 2off = 2^32 — "
+        "EQUAL, so criterion 4 (fewest victims) decides for n1.  A raw "
+        "(unoffset) sum would pick n0 (-2^31 < 0).  900m preemptor, "
+        "victims irreprievable (400/500 + 900 > 1000)",
+        "manual-arithmetic",
+        preempt_nodes[:2], preemptor(900),
+        {"placed_count": 2, "placements": ["n1", "n0"],
+         "fail_type": "Unschedulable",
+         "fail_message": "0/2 nodes are available: 2 Insufficient cpu."},
+        pods=[victim("f", "n0", 400, 0),
+              victim("g", "n0", 300, -(2 ** 30)),
+              victim("h", "n0", 300, -(2 ** 30)),
+              victim("i", "n1", 500, 0), victim("j", "n1", 500, 0)])
+
+    scenario(
+        "preempt_latest_start_time",
+        "criterion 5 (preemption.go:662-671 + util/utils.go:59-81): with "
+        "criteria 1-4 tied (one victim each, priority 10), the node whose "
+        "highest-priority victims' EARLIEST startTime is LATEST wins: "
+        "n1 (2025-06-01) over n0 (2024-01-01)",
+        "manual-arithmetic",
+        preempt_nodes[:2], preemptor(800),
+        {"placed_count": 2, "placements": ["n1", "n0"],
+         "fail_type": "Unschedulable",
+         "fail_message": "0/2 nodes are available: 2 Insufficient cpu."},
+        pods=[victim("k", "n0", 1000, 10,
+                     start_time="2024-01-01T00:00:00Z"),
+              victim("l", "n1", 1000, 10,
+                     start_time="2025-06-01T00:00:00Z")])
+
+    scenario(
+        "ipa_symmetric_anti_weight",
+        "symmetric preferred-anti-affinity scoring (scoring.go:218-257 "
+        "processExistingPod: an EXISTING pod's preferred anti term whose "
+        "selector matches the INCOMING pod subtracts its weight on the "
+        "existing pod's topology value).  E on n0/z0 carries anti "
+        "(w10, app=x, zone); incoming (app=x) has no terms of its own. "
+        "raw: z0 -10, z1 0; min-max normalize (scoring.go:268-300): n0 0, "
+        "n1 100; x weight 2 -> every clone lands on n1",
+        "manual-arithmetic",
+        zone_nodes,
+        {"metadata": {"name": "x", "labels": {"app": "x"},
+                      "namespace": "default"},
+         "spec": {"containers": [{"name": "c", "resources": {
+             "requests": {"cpu": "100m"}}}]}},
+        {"placed_count": 2, "placements": ["n1", "n1"],
+         "fail_type": "LimitReached"},
+        profile_block={"profile": {"score_weights": {"InterPodAffinity": 2}},
+                       "parity": True},
+        max_limit=2,
+        pods=[{"metadata": {"name": "E", "namespace": "default",
+                            "labels": {"app": "e"}},
+               "spec": {"nodeName": "n0", "containers": [
+                   {"name": "c", "resources": {"requests": {"cpu": "100m"}}}],
+                   "affinity": {"podAntiAffinity": {
+                       "preferredDuringSchedulingIgnoredDuringExecution": [{
+                           "weight": 10, "podAffinityTerm": {
+                               "topologyKey": "topology.kubernetes.io/zone",
+                               "labelSelector": {
+                                   "matchLabels": {"app": "x"}}}}]}}}}])
 
 
 if __name__ == "__main__":

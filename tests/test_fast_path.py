@@ -80,3 +80,70 @@ def test_fast_ineligible_with_spread():
     pb = enc.encode_problem(snapshot, default_pod(pod),
                             SchedulerProfile.parity())
     assert not fast_path.eligible(pb)
+
+
+# --- widened eligibility: uniform static-score classes (VERDICT r3 #6) ----
+
+@pytest.mark.parametrize("seed", range(8))
+def test_fast_uniform_taint_class(seed):
+    """Every node carries the SAME PreferNoSchedule taint (a dedicated
+    pool): the reverse-normalized score is a constant, so the fast path is
+    exact — fuzzed against the scan."""
+    rng = np.random.RandomState(100 + seed)
+    taints = [{"key": "pool", "value": "batch", "effect": "PreferNoSchedule"}]
+    nodes = [build_test_node(
+        f"n{i:02d}", int(rng.choice([500, 1000, 2000])),
+        int(rng.choice([2, 4])) * 1024 ** 3, int(rng.choice([5, 20])),
+        taints=list(taints))
+        for i in range(int(rng.choice([3, 9])))]
+    pod = build_test_pod("p", int(rng.choice([100, 250])),
+                         int(rng.choice([64, 200])) * 1024 ** 2)
+    _compare(nodes, pod, limit=int(rng.choice([0, 11])))
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_fast_uniform_preferred_affinity_class(seed):
+    """A preferred node-affinity term matching EVERY node normalizes to a
+    constant 100 — fast path exact on the widened class."""
+    rng = np.random.RandomState(200 + seed)
+    nodes = [build_test_node(
+        f"n{i:02d}", int(rng.choice([500, 1000, 2000])),
+        int(rng.choice([2, 4])) * 1024 ** 3, int(rng.choice([5, 20])),
+        labels={"kubernetes.io/os": "linux"})
+        for i in range(int(rng.choice([3, 9])))]
+    pod = build_test_pod("p", int(rng.choice([100, 250])),
+                         int(rng.choice([64, 200])) * 1024 ** 2)
+    pod["spec"]["affinity"] = {"nodeAffinity": {
+        "preferredDuringSchedulingIgnoredDuringExecution": [{
+            "weight": 7, "preference": {"matchExpressions": [{
+                "key": "kubernetes.io/os", "operator": "In",
+                "values": ["linux"]}]}}]}}
+    _compare(nodes, pod, limit=int(rng.choice([0, 11])))
+
+
+def test_fast_nonuniform_taint_still_ineligible():
+    """One differently-tainted node keeps the class on the scan engine."""
+    taints = [{"key": "pool", "value": "batch", "effect": "PreferNoSchedule"}]
+    nodes = [build_test_node(f"n{i}", 1000, 2 * 1024 ** 3, 10,
+                             taints=list(taints)) for i in range(3)]
+    nodes.append(build_test_node("n3", 1000, 2 * 1024 ** 3, 10))
+    pod = build_test_pod("p", 100, 64 * 1024 ** 2)
+    snapshot = ClusterSnapshot.from_objects(nodes)
+    pb = enc.encode_problem(snapshot, default_pod(pod),
+                            SchedulerProfile.parity())
+    assert not fast_path.eligible(pb)
+
+
+def test_fast_nonuniform_taint_on_statically_excluded_node_ok():
+    """Raw-score variance confined to statically-infeasible nodes (here: a
+    NoSchedule-tainted node the pod does not tolerate) does not break
+    uniformity over the eligible set."""
+    nodes = [build_test_node(f"n{i}", 1000, 2 * 1024 ** 3, 10)
+             for i in range(3)]
+    nodes.append(build_test_node(
+        "n3", 1000, 2 * 1024 ** 3, 10,
+        taints=[{"key": "dedicated", "value": "x", "effect": "NoSchedule"},
+                {"key": "p", "value": "q", "effect": "PreferNoSchedule"}]))
+    pod = build_test_pod("p", 100, 64 * 1024 ** 2)
+    fast = _compare(nodes, pod)
+    assert all(fast.node_names[i] != "n3" for i in fast.placements)

@@ -239,11 +239,8 @@ def test_fallback_reasons():
     snap = ClusterSnapshot.from_objects(_nodes(6))
     prof = SchedulerProfile.parity()
 
-    # priorities differ → preemption pressure → object path
-    hi = _template("hi", 400)
-    hi["spec"]["priority"] = 10
-    assert il.solve_interleaved_tensor(snap, [hi, _template("b", 300)],
-                                       prof) is None
+    # priorities differing no longer falls back (tier-ranked pops are
+    # native, VERDICT r3 #5) — covered differentially below
 
     # extenders → object path
     from cluster_capacity_tpu.engine.extenders import ExtenderConfig
@@ -286,3 +283,115 @@ def test_curability_transition_matches_object_path():
         got = il.solve_interleaved_tensor(snap, [a, b, c], prof,
                                           max_total=mt)
         _assert_same(ref, got, f"transition mt={mt}")
+
+
+# --- priority tiers + preemption (VERDICT r3 #5) --------------------------
+
+def _victim_pod(name, node, cpu_m, priority, labels=None):
+    return {"metadata": {"name": name, "namespace": "default",
+                         "labels": dict(labels or {})},
+            "spec": {"nodeName": node, "priority": priority,
+                     "containers": [{"name": "c", "resources": {
+                         "requests": {"cpu": f"{cpu_m}m"}}}]}}
+
+
+def test_priority_tiers_without_victims():
+    """Tiered templates, no preemption possible (no pod below the floor):
+    high tier drains first, FIFO within tiers — placement-for-placement
+    parity with the object queue loop."""
+    snap = ClusterSnapshot.from_objects(_nodes(8, pods=6))
+    ts = []
+    for k in range(6):
+        t = _template(f"t{k}", 300 + 50 * k)
+        t["spec"]["priority"] = (k % 3) * 10          # three tiers
+        ts.append(t)
+    prof = SchedulerProfile.parity()
+    _assert_same(sweep_interleaved(snap, ts, prof),
+                 il.solve_interleaved_tensor(snap, ts, prof), "tiers")
+
+
+def test_preemption_single_eviction():
+    """A high-priority template preempts an existing low-priority pod;
+    both engines must agree on the eviction's downstream placements."""
+    nodes = _nodes(3, cpus=(1000,), pods=8)
+    victims = [_victim_pod(f"v{i}", f"n{i:03d}", 900, 5) for i in range(3)]
+    snap = ClusterSnapshot.from_objects(nodes, pods=victims)
+    hi = _template("hi", 800)
+    hi["spec"]["priority"] = 100
+    prof = SchedulerProfile.parity()
+    ref = sweep_interleaved(snap, [hi], prof)
+    got = il.solve_interleaved_tensor(snap, [hi], prof)
+    _assert_same(ref, got, "single-eviction")
+    assert ref[0].placed_count == 3        # one per node after evictions
+
+
+def test_preemption_tiered_templates_with_victims():
+    """Two template tiers racing; the high tier evicts the low tier's
+    already-placed clones when capacity runs out (the cross-template
+    victim path) — exact parity including bind-time accounting (evicted
+    clones stay in their owner's report)."""
+    nodes = _nodes(4, cpus=(1000,), pods=8)
+    snap = ClusterSnapshot.from_objects(nodes)
+    lo = _template("lo", 600)
+    lo["spec"]["priority"] = 0
+    hi = _template("hi", 700)
+    hi["spec"]["priority"] = 50
+    prof = SchedulerProfile.parity()
+    _assert_same(sweep_interleaved(snap, [hi, lo], prof),
+                 il.solve_interleaved_tensor(snap, [hi, lo], prof),
+                 "tiered-victims")
+
+
+def test_preemption_pdb_protected_victims():
+    """PDB-protected victims count as violations in pickOneNode; parity
+    through the shared evaluator."""
+    nodes = _nodes(2, cpus=(1000,), pods=8)
+    victims = [_victim_pod("va", "n000", 900, 1, labels={"guard": "y"}),
+               _victim_pod("vb", "n001", 900, 1)]
+    pdb = {"metadata": {"name": "guard", "namespace": "default"},
+           "spec": {"selector": {"matchLabels": {"guard": "y"}}},
+           "status": {"disruptionsAllowed": 0}}
+    snap = ClusterSnapshot.from_objects(nodes, pods=victims, pdbs=[pdb])
+    hi = _template("hi", 800)
+    hi["spec"]["priority"] = 100
+    prof = SchedulerProfile.parity()
+    ref = sweep_interleaved(snap, [hi], prof)
+    got = il.solve_interleaved_tensor(snap, [hi], prof)
+    _assert_same(ref, got, "pdb")
+    # the unprotected victim's node must be chosen first
+    assert ref[0].placements[0] == 1
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_fuzz_tiered_preemption_corpus(seed):
+    """Randomized priority-tiered corpora with existing lower-priority
+    pods (the VERDICT r3 #5 'done' criterion): spread + affinity templates
+    over three tiers, victims present."""
+    rng = np.random.RandomState(400 + seed)
+    nodes = _nodes(int(rng.choice([5, 8])), zones=3,
+                   cpus=(2000,), pods=10, seed=seed)
+    victims = [_victim_pod(f"v{i}", f"n{int(rng.randint(len(nodes))):03d}",
+                           int(rng.choice([500, 1500])), int(rng.choice([0, 3])),
+                           labels={"app": "victim"})
+               for i in range(int(rng.choice([2, 4])))]
+    snap = ClusterSnapshot.from_objects(nodes, pods=victims)
+    ts = []
+    for k in range(int(rng.choice([3, 5]))):
+        kind = k % 3
+        if kind == 0:
+            t = _template(f"t{k}", int(rng.choice([400, 700])),
+                          spread=(int(rng.choice([1, 2])),
+                                  "topology.kubernetes.io/zone",
+                                  {"app": f"t{k}"}))
+        elif kind == 1:
+            t = _template(f"t{k}", int(rng.choice([400, 700])),
+                          pref_anti=(10, "kubernetes.io/hostname",
+                                     {"app": f"t{k}"}))
+        else:
+            t = _template(f"t{k}", int(rng.choice([400, 700])))
+        t["spec"]["priority"] = int(rng.choice([0, 10, 20]))
+        ts.append(t)
+    prof = SchedulerProfile.parity()
+    _assert_same(sweep_interleaved(snap, ts, prof),
+                 il.solve_interleaved_tensor(snap, ts, prof),
+                 f"tier-fuzz-{seed}")
