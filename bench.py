@@ -48,8 +48,30 @@ PROBE_TIMEOUT = int(os.environ.get("BENCH_PROBE_TIMEOUT", "60"))
 BASELINE_PLACEMENTS_PER_SEC = 100.0
 # Persistent compile cache shared with tpu_capture.py: any compile a live
 # window ever paid is reused here, so the bench spends its window measuring.
+
+
+def _host_cache_key() -> str:
+    """Namespace the persistent cache by the host's CPU feature set: a CPU
+    executable cached by a different driver host is invalid here (XLA warns
+    it "could lead to execution errors such as SIGILL" — observed in the r4
+    bench tail).  tpu_capture.py and bench.py run on the same host within a
+    round, so the sharing that motivated the cache survives the keying."""
+    import hashlib
+    import platform
+    txt = platform.machine()
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    txt += " ".join(sorted(line.split(":", 1)[1].split()))
+                    break
+    except OSError:
+        pass
+    return hashlib.sha1(txt.encode()).hexdigest()[:12]
+
+
 _CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                          ".jax_cache")
+                          ".jax_cache", _host_cache_key())
 
 
 def _cache_env(env: dict) -> dict:

@@ -148,6 +148,43 @@ def test_batched_spec_tables_clean(b, k_steps):
     assert check_table([e for e, _m in ins + outs]) == []
 
 
+@pytest.mark.parametrize("b,n", [(100, 1000), (20, 999), (8, 1337),
+                                 (fb.MAX_BATCH, 1000)],
+                         ids=["tpu-failure-geometry", "n999", "n1337",
+                              "maxbatch-n1000"])
+def test_batched_spec_tables_clean_at_scale(b, n):
+    """Pin the exact geometry that failed on TPU in round 4 (B=100 at
+    n=1000, plane count S=8 — the n=100/S=1 lint above could not see it)
+    plus non-multiple-of-128 node counts at scale, so node-count-dependent
+    specs can't regress silently."""
+    from cluster_capacity_tpu.parallel.sweep import _pad_group
+    pods = [_spread_pod(name=f"t{k}", app=f"t{k}", skew=2 + k % 3)
+            for k in range(b)]
+    snap = ClusterSnapshot.from_objects(_nodes(n, zones=8))
+    pbs = [enc.encode_problem(snap, default_pod(p), SchedulerProfile())
+           for p in pods]
+    pbs, cfg, _dnh = _pad_group(pbs)
+    pks = [fused._pack_meta(cfg, pb, None) for pb in pbs]
+    runner_pk = pks[0]._replace(meta=fb._structural_meta(pks[0].meta))
+    tab = fb._scalar_table(runner_pk)
+    for k_steps in (48, 1024):
+        ins, outs = fb._batched_spec_table(runner_pk, tab, b, k_steps)
+        assert check_table([e for e, _m in ins + outs]) == []
+
+
+@pytest.mark.parametrize("n", [1000, 999, 1337])
+def test_fused_spec_tables_clean_at_scale(n):
+    """Single-template kernel spec tables at multi-plane, non-multiple-of-128
+    node counts (the r4 lint only exercised n=150)."""
+    for pod_fn in (_spread_pod, _ipa_pod):
+        pb = _pb(pod_fn(), n=n)
+        cfg = sim.static_config(pb)
+        pk = fused._pack_meta(cfg, pb, None)
+        for k_steps in (48, 4096):
+            ins, outs = fused._spec_table(pk, k_steps)
+            assert check_table(ins + outs) == []
+
+
 def test_compiled_call_refuses_dirty_table(monkeypatch):
     """A violating spec table must refuse the kernel at build time (the
     runner falls back to XLA) instead of dying in Mosaic on device."""
