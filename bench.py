@@ -111,8 +111,13 @@ def _probe_accelerator() -> bool:
 def _make_nodes(n_nodes=None, n_zones=16, cpus=(16000, 32000, 64000),
                 mems=(64, 128, 256), seed=0):
     rng = np.random.RandomState(seed)
+    n = n_nodes if n_nodes is not None else N_NODES
+    # one vectorized draw per attribute (per-node rng.choice is ~10us each —
+    # a full second of setup at 50k nodes)
+    cpu_draw = rng.choice(list(cpus), size=n)
+    mem_draw = rng.choice(list(mems), size=n)
     nodes = []
-    for i in range(n_nodes if n_nodes is not None else N_NODES):
+    for i in range(n):
         nodes.append({
             "metadata": {"name": f"node-{i:06d}",
                          "labels": {"kubernetes.io/hostname": f"node-{i:06d}",
@@ -120,8 +125,8 @@ def _make_nodes(n_nodes=None, n_zones=16, cpus=(16000, 32000, 64000),
                                         f"zone-{i % n_zones}"}},
             "spec": {},
             "status": {"allocatable": {
-                "cpu": f"{int(rng.choice(list(cpus)))}m",
-                "memory": str(int(rng.choice(list(mems))) * 1024 ** 3),
+                "cpu": f"{int(cpu_draw[i])}m",
+                "memory": str(int(mem_draw[i]) * 1024 ** 3),
                 "pods": "110"}},
         })
     return nodes
@@ -246,9 +251,11 @@ def bench_sweep(platform: str):
 
 def bench_c5(platform: str):
     """BASELINE config 5: 50k-node GKE-scale snapshot, FULL default plugin
-    set exercised by the template mix (plain fit/balanced, hard spread,
+    set exercised by the template mix — plain fit/balanced, hard spread,
     preferred inter-pod anti-affinity, tolerations + preferred node
-    affinity, image locality), 1k-template what-if sweep.  Per-template
+    affinity, image locality, WFFC PVCs bounded by CSIStorageCapacity
+    (VolumeBinding active), and DRA per-clone device claims
+    (DynamicResources active) — 1k-template what-if sweep.  Per-template
     placement budget is platform-sized: the point of the key is the
     spec-scale sweep itself and its trend round over round."""
     from cluster_capacity_tpu.models.podspec import default_pod
@@ -274,7 +281,41 @@ def bench_c5(platform: str):
     for i in range(0, n_nodes, 4):       # 25% carry the shared app image
         nodes[i].setdefault("status", {})["images"] = [
             {"names": ["app:v1"], "sizeBytes": 500 * 1024 * 1024}]
-    snapshot = ClusterSnapshot.from_objects(nodes)
+
+    # Volume objects: a WFFC StorageClass whose driver publishes capacity
+    # only for half the zones (CSIStorageCapacity bounds WFFC dynamic
+    # provisioning) + the PVCs the kind-5 templates mount.
+    scs = [{"metadata": {"name": "fast-wffc"},
+            "provisioner": "ebs.csi.example.com",
+            "volumeBindingMode": "WaitForFirstConsumer"}]
+    caps = [{"metadata": {"name": f"cap-z{z}"},
+             "storageClassName": "fast-wffc",
+             "nodeTopology": {"matchLabels": {
+                 "topology.kubernetes.io/zone": f"zone-{z}"}},
+             "capacity": "100Gi"} for z in range(0, 32, 2)]
+    pvcs = [{"metadata": {"name": f"pvc-{j}", "namespace": "default"},
+             "spec": {"storageClassName": "fast-wffc",
+                      "accessModes": ["ReadWriteOnce"],
+                      "resources": {"requests": {"storage": "10Gi"}}}}
+            for j in range(8)]
+    # DRA objects: every 8th node publishes a 4-device slice; kind-6
+    # templates request one device per clone via a claim template.
+    slices = [{"metadata": {"name": f"slice-{i}"},
+               "spec": {"nodeName": f"node-{i:06d}",
+                        "driver": "gpu.example.com",
+                        "devices": [
+                            {"name": f"d{j}",
+                             "deviceClassName": "gpu.example.com"}
+                            for j in range(4)]}}
+              for i in range(0, n_nodes, 8)]
+    claim_tmpls = [{"metadata": {"name": "one-gpu", "namespace": "default"},
+                    "spec": {"spec": {"devices": {"requests": [
+                        {"name": "r0",
+                         "deviceClassName": "gpu.example.com",
+                         "count": 1}]}}}}]
+    snapshot = ClusterSnapshot.from_objects(
+        nodes, storage_classes=scs, csistoragecapacities=caps, pvcs=pvcs,
+        resource_slices=slices, resource_claim_templates=claim_tmpls)
 
     templates = []
     for k in range(n_templates):
@@ -283,7 +324,7 @@ def bench_c5(platform: str):
         pod = {"metadata": {"name": f"t{k}", "labels": {"app": f"t{k}"}},
                "spec": {"containers": [{"name": "c",
                                         "resources": {"requests": req}}]}}
-        kind = k % 5
+        kind = k % 7
         if kind == 1:
             pod["spec"]["topologySpreadConstraints"] = [{
                 "maxSkew": int(rng.choice([4, 8])),
@@ -309,6 +350,13 @@ def bench_c5(platform: str):
                         "values": [f"zone-{k % 32}"]}]}}]}}
         elif kind == 4:
             pod["spec"]["containers"][0]["image"] = "app:v1"
+        elif kind == 5:
+            pod["spec"]["volumes"] = [{
+                "name": "data",
+                "persistentVolumeClaim": {"claimName": f"pvc-{k % 8}"}}]
+        elif kind == 6:
+            pod["spec"]["resourceClaims"] = [
+                {"name": "gpu", "resourceClaimTemplateName": "one-gpu"}]
         templates.append(default_pod(pod))
 
     sweep(snapshot, templates, max_limit=limit)       # warmup compile

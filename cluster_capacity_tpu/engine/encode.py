@@ -178,9 +178,10 @@ def encode_problem(snapshot: ClusterSnapshot, pod: dict,
     dra_enc = dra.encode(
         pod, snapshot.resource_claims, snapshot.resource_claim_templates,
         device_classes=snapshot.device_classes,
-        has_shared_counters=any(
-            (rs.get("spec") or {}).get("sharedCounters")
-            for rs in snapshot.resource_slices)) if dra_on \
+        has_shared_counters=snapshot.memo(
+            ("has_shared_counters",),
+            lambda: any((rs.get("spec") or {}).get("sharedCounters")
+                        for rs in snapshot.resource_slices))) if dra_on \
         else dra.DraEncoding()
     dra_missing_class = False
     shared_req_vec = np.zeros(r, dtype=np.float64)
@@ -272,25 +273,19 @@ def encode_problem(snapshot: ClusterSnapshot, pod: dict,
         if profile.added_affinity:
             # NodeAffinityArgs.addedAffinity: ANDed with the pod's own
             # required affinity for every pod of the profile
-            from ..models.labels import match_node_selector
+            from ..models.labels import node_selector_mask
             required = profile.added_affinity.get(
                 "requiredDuringSchedulingIgnoredDuringExecution")
             if required:
-                added = np.asarray([
-                    match_node_selector(required, snapshot.node_labels(i),
-                                        snapshot.node_names[i])
-                    for i in range(n)], dtype=bool)
-                na_mask = na_mask & added
+                na_mask = na_mask & node_selector_mask(snapshot, required)
         fold(na_mask, CODE_NODE_AFFINITY)
     if enabled("NodePorts"):
         fold(node_ports.static_mask(snapshot, pod), CODE_PORTS)
     if dra_enc.allocation_node_selectors:
-        from ..models.labels import match_node_selector
-        dra_mask = np.asarray([
-            all(match_node_selector(sel, snapshot.node_labels(i),
-                                    snapshot.node_names[i])
-                for sel in dra_enc.allocation_node_selectors)
-            for i in range(n)], dtype=bool)
+        from ..models.labels import node_selector_mask
+        dra_mask = np.ones(n, dtype=bool)
+        for sel in dra_enc.allocation_node_selectors:
+            dra_mask &= node_selector_mask(snapshot, sel)
         fold(dra_mask, CODE_DRA)
     static_mask = np.logical_and.reduce(masks) if masks else np.ones(n, dtype=bool)
 

@@ -57,9 +57,9 @@ def _uniform_on_eligible(pb: enc.EncodedProblem, raw: np.ndarray
     return first if bool((vals == first).all()) else None
 
 
-def eligible(pb: enc.EncodedProblem) -> bool:
-    """Static eligibility: every active score must be a pure per-node function
-    of that node's own placement count, and every filter static-or-fit."""
+def _structural_eligible(pb: enc.EncodedProblem) -> bool:
+    """Filter/score structure the analytic solve can express at all (no
+    carried cross-node state); says nothing about normalization constancy."""
     profile = pb.profile
     if not profile.deterministic:
         # the randomized selectHost tie-break emulation lives in the scan only
@@ -76,6 +76,15 @@ def eligible(pb: enc.EncodedProblem) -> bool:
         return False
     if sim._num_feasible_nodes_to_find(profile, pb.snapshot.num_nodes) > 0:
         return False
+    return True
+
+
+def eligible(pb: enc.EncodedProblem) -> bool:
+    """Static eligibility: every active score must be a pure per-node function
+    of that node's own placement count, and every filter static-or-fit."""
+    if not _structural_eligible(pb):
+        return False
+    profile = pb.profile
     # TaintToleration / NodeAffinity normalize over the per-step feasible
     # set — cross-node in general, but a CONSTANT when the raw scores are
     # uniform over the statically-eligible nodes (VERDICT r3 #6: dedicated
@@ -90,9 +99,52 @@ def eligible(pb: enc.EncodedProblem) -> bool:
     return True
 
 
+def eligible_limited(pb: enc.EncodedProblem) -> bool:
+    """Eligibility for the BOUNDED batched analytic solve: taint/NA raw
+    uniformity is NOT required — a non-uniform static raw still normalizes
+    to a constant per-node vector while some max-raw node stays feasible,
+    which holds for the whole run when that node's capacity covers the
+    budget.  _fast_batch_chunk verifies that per template and falls back
+    when it can't."""
+    return _structural_eligible(pb)
+
+
+def _static_normalized(raw: np.ndarray, caps: np.ndarray, budget: int,
+                       reverse: bool, dt) -> Optional[np.ndarray]:
+    """DefaultNormalizeScore of a STATIC raw vector, exact for a bounded run:
+    the per-step feasible set only ever shrinks (a node leaves when full), so
+    the feasible max is constant while a max-raw node remains feasible — and
+    a node with cap >= budget can never fill within the run.  Returns the
+    normalized dt vector, or None when no max-raw node has cap >= budget.
+    Arithmetic mirrors sim._default_normalize op-for-op in dt."""
+    feas = caps > 0
+    raw_dt = raw.astype(dt)
+    hundred = np.asarray(100.0, dtype=dt)
+    m = np.max(np.where(feas, raw_dt, np.asarray(0.0, dtype=dt))) \
+        if raw_dt.size else np.asarray(0.0, dtype=dt)
+    if m > 0:
+        holders = feas & (raw_dt == m)
+        if not bool((caps[holders] >= budget).any()):
+            return None
+        scaled = np.floor(hundred * raw_dt / m)
+        if reverse:
+            scaled = hundred - scaled
+    else:
+        scaled = np.full_like(raw_dt, 100.0) if reverse else raw_dt
+    return scaled
+
+
 def _per_node_caps(pb: enc.EncodedProblem) -> np.ndarray:
     """Max clones each node can take under the fit filter (and pod slots)."""
-    free = pb.allocatable - pb.init_requested
+    snap = pb.snapshot
+    if pb.allocatable is getattr(snap, "allocatable", None) \
+            and pb.init_requested is getattr(snap, "requested", None):
+        # snapshot-owned arrays (no virtual columns): the free matrix is
+        # template-independent — compute once per snapshot
+        free = snap.memo(("free_matrix",),
+                         lambda: pb.allocatable - pb.init_requested)
+    else:
+        free = pb.allocatable - pb.init_requested
     caps = np.maximum(pb.allocatable[:, IDX_PODS]
                       - pb.init_requested[:, IDX_PODS], 0.0)
     if pb.profile.filter_enabled("NodeResourcesFit"):
@@ -272,3 +324,306 @@ def solve_auto(pb: enc.EncodedProblem, max_limit: int = 0,
     if result is not None:
         return result
     return sim.solve(pb, max_limit=max_limit, chunk_size=chunk_size)
+
+
+# --------------------------------------------------------------------------
+# Batched analytic solve: B small-limit templates in one argsort
+# --------------------------------------------------------------------------
+# A what-if sweep with a small per-template limit (BASELINE config 5's
+# limit-3 probes) spends its time stepping the scan engine B times for a
+# question the analytic path answers with a [B, N, K] score tensor and ONE
+# stable argsort over [B, N*K].  Score arithmetic mirrors solve_fast
+# component-for-component in the same dtype and addition order, so the
+# placements are bit-identical (tests/test_sweep.py differential).
+
+_ELEM_BUDGET = 1 << 27          # max B*N*K elements materialized per chunk
+
+
+def solve_fast_batched(pbs, max_limit: int):
+    """Solve B eligible templates (uniform StaticConfig group) at a small
+    max_limit.  Returns a list aligned with pbs; None entries mean "fall
+    back to solve_auto" (zero capacity -> needs scan diagnosis, or a
+    monotonicity failure)."""
+    out = [None] * len(pbs)
+    if not max_limit or max_limit <= 0 or not pbs:
+        return out
+    n = pbs[0].snapshot.num_nodes
+    if n == 0:
+        return out
+    sim._ensure_x64(pbs[0].profile)
+    cfg = sim.static_config(pbs[0])
+
+    caps_list, budgets, act = [], [], []
+    for b, pb in enumerate(pbs):
+        caps = _per_node_caps(pb)
+        tc = int(caps.sum())
+        if tc < max_limit:
+            # zero capacity, or capacity exhausts before the limit: either
+            # way the template needs the scan's exact diagnosis — running
+            # it through the kernel would only discard the result
+            continue
+        budget = min(max_limit, tc, sim._DEFAULT_UNLIMITED_CAP)
+        caps_list.append(np.minimum(caps, budget))
+        budgets.append(budget)
+        act.append(b)
+    if not act:
+        return out
+
+    k_hint = int(max(c.max() for c in caps_list))
+    chunk = max(1, _ELEM_BUDGET // max(1, n * k_hint))
+    for s in range(0, len(act), chunk):
+        res = _fast_batch_chunk(
+            [pbs[i] for i in act[s:s + chunk]], caps_list[s:s + chunk],
+            budgets[s:s + chunk], cfg, max_limit)
+        for i, r in zip(act[s:s + chunk], res):
+            out[i] = r
+    return out
+
+
+def _unique_rows(rows, n: int, dt):
+    """Dedup per-template [N] vectors by identity/constant value: returns
+    (unique [U, N] dt, idx i32[B]).  Entries are either ('const', v) or a
+    numpy vector (snapshot-memoized objects dedup by id)."""
+    uniq: list = []
+    keymap: dict = {}
+    idx = np.zeros(len(rows), dtype=np.int32)
+    for bi, r in enumerate(rows):
+        key = r if isinstance(r, tuple) else id(r)
+        u = keymap.get(key)
+        if u is None:
+            u = len(uniq)
+            keymap[key] = u
+            uniq.append(np.full(n, r[1], dtype=dt) if isinstance(r, tuple)
+                        else np.asarray(r, dtype=dt))
+        idx[bi] = u
+    return np.stack(uniq), idx
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=None)
+def _fast_batch_device(strategy: str, fit_shape, K: int, m: int, n: int,
+                       w_fit: float, w_bal: float, w_t: float, w_na: float,
+                       w_il: float, dt_name: str):
+    """One jitted kernel for the whole batched analytic solve: fused score
+    construction (shared [N, R] inputs + per-template [B, R] vectors — no
+    [B, N, ...] host stacks), monotonicity check, and top-m selection."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    dt = jnp.float64 if dt_name == "float64" else jnp.float32
+
+    @jax.jit
+    def run(alloc_f, base_f, inc_f, freq, fit_w,
+            alloc_b, base_b, inc_b, breq,
+            t_u, t_ix, na_u, na_ix, il_u, il_ix, caps):
+        B = caps.shape[0]
+        k_axis = jnp.arange(K, dtype=dt)
+        total = jnp.zeros((B, n, K), dtype=dt)
+
+        if w_fit:
+            # [B, N, K, R] lazily broadcast — the score reductions run over
+            # the trailing axis, so XLA fuses the whole construction without
+            # materializing the 4-D operands (no reshape in the chain).
+            req = base_f.astype(dt)[None, :, None, :] \
+                + inc_f.astype(dt)[:, None, None, :] \
+                * k_axis[None, None, :, None] \
+                + freq.astype(dt)[:, None, None, :]
+            a4 = alloc_f.astype(dt)[None, :, None, :]
+            if strategy == "MostAllocated":
+                from ..ops.node_resources_fit import most_allocated_score
+                s = most_allocated_score(a4, req, fit_w.astype(dt))
+            elif strategy == "RequestedToCapacityRatio":
+                from ..ops.node_resources_fit import (
+                    requested_to_capacity_ratio_score)
+                s = requested_to_capacity_ratio_score(
+                    a4, req, fit_w.astype(dt), fit_shape[0], fit_shape[1])
+            else:
+                from ..ops.node_resources_fit import least_allocated_score
+                s = least_allocated_score(a4, req, fit_w.astype(dt))
+            total = total + w_fit * s
+
+        if w_bal:
+            from ..ops.node_resources_fit import balanced_allocation_score
+            req = base_b.astype(dt)[None, :, None, :] \
+                + inc_b.astype(dt)[:, None, None, :] \
+                * k_axis[None, None, :, None] \
+                + breq.astype(dt)[:, None, None, :]
+            a4 = alloc_b.astype(dt)[None, :, None, :]
+            s = balanced_allocation_score(jnp.broadcast_to(a4, req.shape), req)
+            total = total + w_bal * s
+
+        if w_t:
+            total = total + (w_t * t_u)[t_ix][:, :, None]
+        if w_na:
+            total = total + (w_na * na_u)[na_ix][:, :, None]
+        if w_il:
+            total = total + il_u[il_ix][:, :, None] * w_il
+
+        capsf = caps.astype(dt)
+        valid = k_axis[None, None, :] < capsf[:, :, None]
+        mono = jnp.all(jnp.where(valid[:, :, 1:],
+                                 total[:, :, 1:] <= total[:, :, :-1], True),
+                       axis=(1, 2))
+        neg_inf = jnp.asarray(-jnp.inf, dt)
+        flat = jnp.where(valid, total, neg_inf).reshape(B, n * K)
+        # Only the first max_limit placements are consumed, and ties must
+        # break toward the LOWER flat index — the (score desc, node asc,
+        # k asc) order solve_fast's stable argsort encodes (the flat axis is
+        # node-major).  For small m, m masked-argmax passes (single-pass
+        # reductions; argmax takes the first maximum) beat XLA CPU's TopK
+        # (a per-row sort); larger m uses TopK (also lower-index-first).
+        if m <= 32:
+            def body(fl, _):
+                idx = jnp.argmax(fl, axis=1)              # [B]
+                fl = fl.at[jnp.arange(fl.shape[0]), idx].set(neg_inf)
+                return fl, idx
+            _fl, idxs = lax.scan(body, flat, None, length=m)
+            order_m = idxs.T                              # [B, m]
+        else:
+            _vals, order_m = lax.top_k(flat, m)
+        node_ids = jnp.repeat(jnp.arange(n, dtype=jnp.int32), K)
+        chosen = node_ids[order_m]                        # [B, m]
+        return mono, chosen
+
+    return run
+
+
+def _fast_batch_chunk(sub, caps_list, budgets, cfg, max_limit: int):
+    B = len(sub)
+    n = sub[0].snapshot.num_nodes
+    K = int(max(c.max() for c in caps_list))
+    profile = sub[0].profile
+    dt = np.float64 if profile.compute_dtype == "float64" else np.float32
+    drop = [False] * B                   # per-template fallback to solve_auto
+    _z1 = np.zeros((1,), dtype=np.float64)
+    _z2 = np.zeros((1, 1), dtype=np.float64)
+    _zi = np.zeros(B, dtype=np.int32)
+
+    # ---- fit inputs: base/alloc are snapshot-shared, inc/freq per template
+    w_fit = float(profile.score_weight("NodeResourcesFit") or 0.0)
+    alloc_f = base_f = _z2
+    inc_f = freq = _z2
+    fit_w = _z1
+    if w_fit:
+        cols = list(cfg.fit_idx)
+        if not _shared_columns(sub, cols):
+            return [None] * B             # virtual-column divergence: rare
+        pb0 = sub[0]
+        alloc_f = pb0.allocatable[:, cols].astype(np.float64)
+        base_f = pb0.init_requested[:, cols].astype(np.float64)
+        inc_f = np.stack([pb.req_vec[cols] for pb in sub]).astype(np.float64)
+        freq = np.stack([pb.fit_req for pb in sub]).astype(np.float64)
+        for k, j in enumerate(cols):
+            if cfg.fit_nz[k]:
+                nzc = 0 if j == IDX_CPU else 1
+                base_f[:, k] = pb0.init_nonzero[:, nzc]
+                for bi, pb in enumerate(sub):
+                    inc_f[bi, k] = pb.req_nonzero[nzc]
+        fit_w = np.asarray(pb0.fit_res_weights, dtype=np.float64)
+
+    w_bal = float(profile.score_weight("NodeResourcesBalancedAllocation")
+                  or 0.0)
+    alloc_b = base_b = inc_b = breq = _z2
+    if w_bal:
+        bcols = list(cfg.bal_idx)
+        if not _shared_columns(sub, bcols):
+            return [None] * B
+        pb0 = sub[0]
+        alloc_b = pb0.allocatable[:, bcols].astype(np.float64)
+        base_b = pb0.init_requested[:, bcols].astype(np.float64)
+        inc_b = np.stack([pb.req_vec[bcols] for pb in sub]).astype(np.float64)
+        breq = np.stack([pb.balanced_req for pb in sub]).astype(np.float64)
+
+    # ---- static per-node score rows, deduped by identity/constant --------
+    norm_cache: dict = {}
+
+    def _row_entries(raw_of, reverse: bool, active_of):
+        entries = []
+        for bi, pb in enumerate(sub):
+            if not active_of(pb):
+                entries.append(("const", 0.0))
+                continue
+            raw = raw_of(pb)
+            r = _uniform_on_eligible(pb, raw)
+            if r is not None:
+                on = (not r) if reverse else bool(r)
+                entries.append(("const", 100.0 if on else 0.0))
+                continue
+            sn = _static_normalized(raw, caps_list[bi], budgets[bi],
+                                    reverse=reverse, dt=dt)
+            if sn is None:
+                drop[bi] = True
+                entries.append(("const", 0.0))
+            else:
+                key = (id(raw), reverse)
+                cached = norm_cache.get(key)
+                if cached is not None and np.array_equal(cached, sn):
+                    sn = cached            # stable id across templates
+                else:
+                    norm_cache[key] = sn
+                entries.append(sn)
+        return entries
+
+    w_t = float(profile.score_weight("TaintToleration") or 0.0)
+    t_u, t_ix = (_z2, _zi)
+    if w_t:
+        t_u, t_ix = _unique_rows(
+            _row_entries(lambda pb: pb.taint_raw, True, lambda pb: True),
+            n, dt)
+    w_na = float(profile.score_weight("NodeAffinity") or 0.0)
+    na_u, na_ix = (_z2, _zi)
+    if w_na:
+        na_u, na_ix = _unique_rows(
+            _row_entries(lambda pb: pb.node_affinity_raw, False,
+                         lambda pb: pb.node_affinity_active), n, dt)
+    w_il = float(profile.score_weight("ImageLocality") or 0.0)
+    il_u, il_ix = (_z2, _zi)
+    if w_il:
+        il_u, il_ix = _unique_rows([pb.image_locality_score for pb in sub],
+                                   n, dt)
+
+    caps = np.stack(caps_list).astype(np.int32)
+    m = min(max_limit, n * K)
+    run = _fast_batch_device(
+        cfg.fit_strategy_type, cfg.fit_shape, K, m, n,
+        w_fit, w_bal, w_t, w_na, w_il, profile.compute_dtype or "float32")
+    mono, chosen = run(alloc_f, base_f, inc_f, freq, fit_w,
+                       alloc_b, base_b, inc_b, breq,
+                       t_u, t_ix, na_u, na_ix, il_u, il_ix, caps)
+
+    mono_np = np.asarray(mono)
+    chosen_np = np.asarray(chosen)
+    results = []
+    for bi, pb in enumerate(sub):
+        if drop[bi] or not bool(mono_np[bi]) or budgets[bi] < max_limit:
+            # normalization constancy unprovable, monotonicity failed, or
+            # capacity exhausts before the limit (needs the exact diagnose)
+            # -> per-template fallback
+            results.append(None)
+            continue
+        placements = chosen_np[bi, :budgets[bi]].astype(int).tolist()
+        results.append(sim.SolveResult(
+            placements=placements, placed_count=len(placements),
+            fail_type=sim.FAIL_LIMIT_REACHED,
+            fail_message=f"Maximum number of pods simulated: {max_limit}",
+            node_names=pb.snapshot.node_names))
+    return results
+
+
+def _shared_columns(sub, cols) -> bool:
+    """True when every template's allocatable/init_requested (restricted to
+    the selected strategy columns) and init_nonzero agree — the condition
+    for passing them to the device once, unbatched.  Virtual resource
+    columns OUTSIDE `cols` may differ freely."""
+    pb0 = sub[0]
+    for pb in sub[1:]:
+        for fld in ("allocatable", "init_requested"):
+            a, b = getattr(pb, fld), getattr(pb0, fld)
+            if a is not b and not np.array_equal(a[:, cols], b[:, cols]):
+                return False
+        a, b = pb.init_nonzero, pb0.init_nonzero
+        if a is not b and not np.array_equal(a, b):
+            return False
+    return True

@@ -722,6 +722,12 @@ class BatchedFusedRunner:
     def unpack(self, state, template):
         return _unpack_carry_batched(self.pk, state[0], state[1], template)
 
+    def stopped_flags(self, state) -> np.ndarray:
+        """bool[B] per-template stopped flags from the packed scalar plane —
+        no plane unpack (the full unpack is a [B, P, S*128] device->host
+        round trip; limit-reached sweeps never need it)."""
+        return np.asarray(state[1])[:self.b, 1] > 0.5
+
     def run_packed(self, state, k_steps: int):
         """One fused chunk for the whole group.  Returns (new_state,
         chosen[k_steps, B], all_stopped)."""

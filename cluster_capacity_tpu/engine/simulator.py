@@ -221,20 +221,44 @@ def _default_normalize(raw, feasible, reverse: bool):
 def _expand_counts(init_counts: np.ndarray, node_domain: np.ndarray) -> np.ndarray:
     """Materialize counts[c, dom[c, n]] per node (0 where the key is absent) —
     the static seed of the carried per-node count tensors."""
+    if not init_counts.any():
+        # no existing pods contribute counts (the what-if sweep norm): the
+        # expansion is all zeros — skip the [C, N] gather per template
+        return np.zeros(node_domain.shape, dtype=init_counts.dtype)
     safe = np.clip(node_domain, 0, init_counts.shape[1] - 1)
     out = np.take_along_axis(init_counts, safe, axis=1)
     return np.where(node_domain >= 0, out, 0.0)
 
 
 def build_consts(pb: enc.EncodedProblem,
-                 ss_dnh_min: int = 1) -> Dict[str, "jax.Array"]:
+                 ss_dnh_min: int = 1,
+                 device: bool = True) -> Dict[str, "jax.Array"]:
     """Move all static arrays to device once, in the profile dtype.
 
     ss_dnh_min pads the soft-spread one-hot's domain axis up to a group-wide
-    size so batched sweeps can stack consts across templates."""
-    import jax.numpy as jnp
-    dt = jnp.float64 if pb.profile.compute_dtype == "float64" else jnp.float32
-    f = lambda a: jnp.asarray(a, dtype=dt)
+    size so batched sweeps can stack consts across templates.
+
+    device=False keeps every array on the host as numpy: the batched sweep
+    builds B per-template const dicts, np.stacks them, and pays ONE device
+    transfer per key instead of ~33 x B small ones."""
+    if device:
+        import jax.numpy as jnp
+        xp = jnp
+    else:
+        xp = np
+    dt = np.float64 if pb.profile.compute_dtype == "float64" else np.float32
+    f = lambda a: xp.asarray(a, dtype=dt)
+    jnp = xp  # the literal asarray calls below follow the same backend
+
+    def f_snap(a, name):
+        # Host-path cast of a snapshot-owned array, memoized on the snapshot:
+        # every template of a sweep group then holds the SAME object, so the
+        # group dedup (parallel/sweep._group_uniform) is an `is` check
+        # instead of a B-way content compare.
+        if not device and a is getattr(pb.snapshot, name, None):
+            return pb.snapshot.memo(("consts_cast", name, str(dt)),
+                                    lambda: np.asarray(a, dtype=dt))
+        return f(a)
     sh, ss, ipa = pb.spread_hard, pb.spread_soft, pb.ipa
 
     # Soft-constraint domain membership one-hots for NON-hostname rows: the
@@ -261,7 +285,7 @@ def build_consts(pb: enc.EncodedProblem,
         ipa_ops.group_fold(ipa)
 
     return {
-        "allocatable": f(pb.allocatable),
+        "allocatable": f_snap(pb.allocatable, "allocatable"),
         "req_vec": f(pb.req_vec),
         "shared_req_vec": f(pb.shared_req_vec),
         "req_nonzero": f(pb.req_nonzero),
@@ -303,9 +327,15 @@ def build_consts(pb: enc.EncodedProblem,
     }
 
 
-def _init_carry(pb: enc.EncodedProblem, consts, seed: int) -> Carry:
-    import jax
-    import jax.numpy as jnp
+def _init_carry(pb: enc.EncodedProblem, consts, seed: int,
+                device: bool = True) -> Carry:
+    """device=False mirrors build_consts(device=False): numpy leaves for the
+    batched sweep's host-side stack (the PRNG key bytes are identical —
+    np.asarray of the same PRNGKey)."""
+    if device:
+        import jax.numpy as jnp
+    else:
+        jnp = np
     dt = consts["allocatable"].dtype
     n = pb.snapshot.num_nodes
     g = pb.ipa.node_domain.shape[0]
@@ -322,8 +352,21 @@ def _init_carry(pb: enc.EncodedProblem, consts, seed: int) -> Carry:
         placed_count=jnp.zeros((), dtype=jnp.int32),
         stopped=jnp.zeros((), dtype=bool),
         next_start=jnp.zeros((), dtype=jnp.int32),
-        rng=jax.random.PRNGKey(seed),
+        rng=_prng_key(seed, device=device),
     )
+
+
+@functools.lru_cache(maxsize=None)
+def _prng_key_host(seed: int) -> np.ndarray:
+    import jax
+    return np.asarray(jax.random.PRNGKey(seed))
+
+
+def _prng_key(seed: int, device: bool = True):
+    if device:
+        import jax
+        return jax.random.PRNGKey(seed)
+    return _prng_key_host(seed)
 
 
 def _col(mat: "jax.Array", chosen: "jax.Array") -> "jax.Array":

@@ -165,6 +165,14 @@ class ClusterSnapshot:
         return self.memo(("labels_have_key", key),
                          lambda: self.topology_domains(key)[0] >= 0)
 
+    def nodes_with_pods(self) -> List[int]:
+        """Node indices with a non-empty pod roster — encoders iterating
+        existing pods loop over these instead of all N nodes (a 50k-node
+        what-if snapshot usually carries few or no pods)."""
+        return self.memo(("nodes_with_pods",),
+                         lambda: [i for i, p in enumerate(self.pods_by_node)
+                                  if p])
+
     @classmethod
     def from_objects(cls, nodes: Sequence[Mapping],
                      pods: Sequence[Mapping] = (),

@@ -186,7 +186,7 @@ def encode(snapshot: ClusterSnapshot, pod: Mapping,
 
     aff_init = np.zeros((g, d_max), dtype=np.float64)
     anti_init = np.zeros((g, d_max), dtype=np.float64)
-    for i in range(n):
+    for i in snapshot.nodes_with_pods():
         for p in snapshot.pods_by_node[i]:
             for terms, groups, init in ((aff_terms, aff_group, aff_init),
                                         (anti_terms, anti_group, anti_init)):
@@ -208,7 +208,7 @@ def encode(snapshot: ClusterSnapshot, pod: Mapping,
     # Existing pods' required anti-affinity vs the incoming pod → static
     # per-node block mask (their terms never change during the simulation).
     blocked_pairs = set()
-    for i in range(n):
+    for i in snapshot.nodes_with_pods():
         for p in snapshot.pods_by_node[i]:
             p_ns = (p.get("metadata") or {}).get("namespace") or "default"
             for term in _required_terms(p, "podAntiAffinity"):
@@ -240,7 +240,7 @@ def encode(snapshot: ClusterSnapshot, pod: Mapping,
             pair_scores[(key, val)] = pair_scores.get((key, val), 0.0) + weight
 
     has_pref_constraints = bool(soft_terms)
-    for i in range(n):
+    for i in snapshot.nodes_with_pods():
         for p in snapshot.pods_by_node[i]:
             p_ns = (p.get("metadata") or {}).get("namespace") or "default"
             p_has_affinity = bool((p.get("spec") or {}).get("affinity"))

@@ -14,8 +14,8 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..models.labels import (pod_matches_node_selector_and_affinity,
-                             preferred_node_affinity_score)
+from ..models.labels import (preferred_node_affinity_scores,
+                             selector_and_affinity_mask)
 from ..models.snapshot import ClusterSnapshot
 
 REASON = "node(s) didn't match Pod's node affinity/selector"
@@ -37,10 +37,9 @@ def static_mask(snapshot: ClusterSnapshot, pod: dict) -> np.ndarray:
     sweep use case encodes many templates against one snapshot, and the
     spread encoder's nodeAffinityPolicy=Honor pass reuses the same mask."""
     spec = pod.get("spec") or {}
-    return snapshot.memo(("na_mask", _required_key(spec)), lambda: np.asarray(
-        [pod_matches_node_selector_and_affinity(spec, snapshot.node_labels(i),
-                                                snapshot.node_names[i])
-         for i in range(snapshot.num_nodes)], dtype=bool))
+    return snapshot.memo(
+        ("na_mask", _required_key(spec)),
+        lambda: selector_and_affinity_mask(snapshot, spec))
 
 
 def has_preferred_terms(pod: dict, added_affinity: dict = None) -> bool:
@@ -75,7 +74,5 @@ def static_raw_score(snapshot: ClusterSnapshot, pod: dict,
     merged = ((spec.get("affinity") or {}).get("nodeAffinity") or {}).get(
         "preferredDuringSchedulingIgnoredDuringExecution")
     key = ("na_raw", json.dumps(merged, sort_keys=True))
-    return snapshot.memo(key, lambda: np.asarray(
-        [preferred_node_affinity_score(spec, snapshot.node_labels(i),
-                                       snapshot.node_names[i])
-         for i in range(snapshot.num_nodes)], dtype=np.float64))
+    return snapshot.memo(
+        key, lambda: preferred_node_affinity_scores(snapshot, spec))

@@ -59,28 +59,32 @@ def fit_filter(allocatable: jnp.ndarray, requested: jnp.ndarray,
 
 def least_allocated_score(alloc: jnp.ndarray, req_with_pod: jnp.ndarray,
                           weights: jnp.ndarray) -> jnp.ndarray:
-    """leastResourceScorer over [N, K] strategy-resource views.
+    """leastResourceScorer over [..., K] strategy-resource views.
 
-    alloc, req_with_pod: [N, K]; weights: [K].  Resources with alloc==0 are
-    skipped (dropped from the weighted mean for that node)."""
+    alloc, req_with_pod: [..., K]; weights: [K].  Resources with alloc==0 are
+    skipped (dropped from the weighted mean for that node).  Any leading
+    batch shape works — reductions run over the trailing resource axis (the
+    batched analytic solve passes [B, N, Kc, K] without materializing a
+    reshape)."""
     valid = alloc > 0
     over = req_with_pod > alloc
     per_res = jnp.where(over, 0.0, _floor_div((alloc - req_with_pod) * MAX_NODE_SCORE,
                                               alloc))
     per_res = jnp.where(valid, per_res, 0.0)
-    wsum = jnp.sum(jnp.where(valid, weights[None, :], 0.0), axis=1)
-    total = jnp.sum(per_res * weights[None, :], axis=1)
+    wsum = jnp.sum(jnp.where(valid, weights, 0.0), axis=-1)
+    total = jnp.sum(per_res * weights, axis=-1)
     return jnp.where(wsum > 0, _floor_div(total, wsum), 0.0)
 
 
 def most_allocated_score(alloc: jnp.ndarray, req_with_pod: jnp.ndarray,
                          weights: jnp.ndarray) -> jnp.ndarray:
-    """mostResourceScorer: requested clamped to capacity."""
+    """mostResourceScorer: requested clamped to capacity.  [..., K] like
+    least_allocated_score."""
     valid = alloc > 0
     req = jnp.minimum(req_with_pod, alloc)
     per_res = jnp.where(valid, _floor_div(req * MAX_NODE_SCORE, alloc), 0.0)
-    wsum = jnp.sum(jnp.where(valid, weights[None, :], 0.0), axis=1)
-    total = jnp.sum(per_res * weights[None, :], axis=1)
+    wsum = jnp.sum(jnp.where(valid, weights, 0.0), axis=-1)
+    total = jnp.sum(per_res * weights, axis=-1)
     return jnp.where(wsum > 0, _floor_div(total, wsum), 0.0)
 
 
@@ -130,8 +134,8 @@ def requested_to_capacity_ratio_score(alloc: jnp.ndarray,
     per_res = jnp.trunc(piecewise_shape(util, shape_utilization, shape_score))
     per_res = jnp.where(valid, per_res, 0.0)
     counted = valid & (per_res > 0)
-    wsum = jnp.sum(jnp.where(counted, weights[None, :], 0.0), axis=1)
-    total = jnp.sum(per_res * weights[None, :], axis=1)
+    wsum = jnp.sum(jnp.where(counted, weights, 0.0), axis=-1)
+    total = jnp.sum(per_res * weights, axis=-1)
     return jnp.where(wsum > 0,
                      jnp.floor(total / jnp.maximum(wsum, 1e-30) + 0.5), 0.0)
 
@@ -148,9 +152,9 @@ def balanced_allocation_score(alloc: jnp.ndarray,
     valid = alloc > 0
     frac = jnp.where(valid, jnp.minimum(req_with_pod / jnp.maximum(alloc, 1e-30),
                                         1.0), 0.0)
-    count = jnp.sum(valid, axis=1)
-    mean = jnp.sum(frac, axis=1) / jnp.maximum(count, 1)
-    var = jnp.sum(jnp.where(valid, (frac - mean[:, None]) ** 2, 0.0), axis=1) \
+    count = jnp.sum(valid, axis=-1)
+    mean = jnp.sum(frac, axis=-1) / jnp.maximum(count, 1)
+    var = jnp.sum(jnp.where(valid, (frac - mean[..., None]) ** 2, 0.0), axis=-1) \
         / jnp.maximum(count, 1)
     std_general = jnp.sqrt(var)
     # Exactly-two-resources fast path used by upstream: |f0 - f1| / 2 computed

@@ -56,7 +56,10 @@ def static_mask_and_reasons(snapshot: ClusterSnapshot, pod: dict
         return mask, tuple(reasons)
 
     mask, reasons = snapshot.memo(("taint_mask", _tols_key(tols)), build)
-    return mask, list(reasons)
+    # the memoized tuple is returned as-is (read-only by contract): copying
+    # it to a fresh 50k-entry list per template was a measurable share of
+    # sweep encode time
+    return mask, reasons
 
 
 def static_raw_score(snapshot: ClusterSnapshot, pod: dict) -> np.ndarray:

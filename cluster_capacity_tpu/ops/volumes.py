@@ -89,7 +89,28 @@ def _sc_map(snapshot: ClusterSnapshot) -> Dict[str, dict]:
 
 def evaluate(snapshot: ClusterSnapshot, pod: Mapping,
              filters_enabled) -> VolumeVerdict:
-    """Run all four volume plugins' static logic for the template."""
+    """Run all four volume plugins' static logic for the template.
+
+    Memoized per (snapshot, namespace, spec.volumes, enabled plugin set):
+    the verdict reads ONLY those pod slices (the sweep dedup signature in
+    parallel/sweep.py relies on the same contract), and a what-if sweep
+    encodes many templates sharing a handful of volume shapes — the WFFC
+    capacity walk is a Python loop over all N nodes, far too hot to repeat
+    per template.  Treat the returned verdict as read-only."""
+    import json
+    meta_ns = (pod.get("metadata") or {}).get("namespace") or "default"
+    key = ("vol_eval", meta_ns,
+           json.dumps((pod.get("spec") or {}).get("volumes"),
+                      sort_keys=True, default=str),
+           tuple(filters_enabled(p) for p in (
+               "VolumeBinding", "VolumeRestrictions", "NodeVolumeLimits",
+               "VolumeZone")))
+    return snapshot.memo(key, lambda: _evaluate_impl(snapshot, pod,
+                                                     filters_enabled))
+
+
+def _evaluate_impl(snapshot: ClusterSnapshot, pod: Mapping,
+                   filters_enabled) -> VolumeVerdict:
     n = snapshot.num_nodes
     namespace = (pod.get("metadata") or {}).get("namespace") or "default"
     volumes = _pod_volumes(pod)

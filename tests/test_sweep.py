@@ -77,6 +77,119 @@ def test_hard_pod_affinity_weight_steers_score():
     assert res.placements and res.node_names[res.placements[0]] == "magnet"
 
 
+def test_sweep_small_limit_batched_fast_path_differential():
+    """The bounded batched analytic solve (fast_path.solve_fast_batched) must
+    place bit-identically to per-template scan solves across the config-5
+    template mix — plain, spread, preferred anti-affinity, tolerations +
+    preferred zone affinity (NON-uniform NodeAffinity raw), image locality —
+    on a cluster with non-uniform PreferNoSchedule taints."""
+    import numpy as np
+    rng = np.random.RandomState(3)
+    nodes = []
+    for i in range(60):
+        node = build_test_node(
+            f"n{i:03d}", int(rng.choice([4000, 8000])), 16 * 1024 ** 3, 110,
+            labels={"kubernetes.io/hostname": f"n{i:03d}",
+                    "topology.kubernetes.io/zone": f"z{i % 4}"})
+        if i % 10 == 0:
+            node["spec"]["taints"] = [{"key": "zp", "value": "h",
+                                       "effect": "PreferNoSchedule"}]
+        if i % 4 == 0:
+            node["status"]["images"] = [
+                {"names": ["app:v1"], "sizeBytes": 400 * 1024 * 1024}]
+        nodes.append(node)
+    snapshot = ClusterSnapshot.from_objects(nodes)
+    templates = []
+    for k in range(15):
+        pod = build_test_pod(f"t{k}", 100 * (1 + k % 3), 256 * 1024 ** 2,
+                             labels={"app": f"t{k}"})
+        kind = k % 5
+        if kind == 1:
+            pod["spec"]["topologySpreadConstraints"] = [{
+                "maxSkew": 2, "topologyKey": "topology.kubernetes.io/zone",
+                "whenUnsatisfiable": "DoNotSchedule",
+                "labelSelector": {"matchLabels": {"app": f"t{k}"}}}]
+        elif kind == 2:
+            pod["spec"]["affinity"] = {"podAntiAffinity": {
+                "preferredDuringSchedulingIgnoredDuringExecution": [{
+                    "weight": 10, "podAffinityTerm": {
+                        "topologyKey": "kubernetes.io/hostname",
+                        "labelSelector": {"matchLabels": {"app": f"t{k}"}}}}]}}
+        elif kind == 3:
+            pod["spec"]["affinity"] = {"nodeAffinity": {
+                "preferredDuringSchedulingIgnoredDuringExecution": [{
+                    "weight": 5, "preference": {"matchExpressions": [{
+                        "key": "topology.kubernetes.io/zone",
+                        "operator": "In", "values": [f"z{k % 4}"]}]}}]}}
+        elif kind == 4:
+            pod["spec"]["containers"][0]["image"] = "app:v1"
+        templates.append(default_pod(pod))
+    profile = SchedulerProfile()
+    for limit in (3, 7):
+        swept = sweep(snapshot, templates, profile=profile, max_limit=limit)
+        for t, batched in zip(templates, swept):
+            pb = enc.encode_problem(snapshot, t, profile)
+            seq = sim.solve(pb, max_limit=limit)
+            name = t["metadata"]["name"]
+            assert batched.placements == seq.placements, (name, limit)
+            assert batched.fail_type == seq.fail_type, (name, limit)
+
+
+def test_sweep_small_limit_capacity_exhausts_before_limit():
+    """A template whose capacity runs out below the limit must fall back to
+    the exact scan diagnosis (batched analytic returns None for it)."""
+    nodes = [build_test_node(f"n{i}", 1000, 2 * 1024 ** 3, 2)
+             for i in range(2)]
+    snapshot = ClusterSnapshot.from_objects(nodes)
+    templates = [default_pod(build_test_pod(f"t{k}", 400, 256 * 1024 ** 2))
+                 for k in range(3)]
+    profile = SchedulerProfile()
+    swept = sweep(snapshot, templates, profile=profile, max_limit=50)
+    for t, batched in zip(templates, swept):
+        pb = enc.encode_problem(snapshot, t, profile)
+        seq = sim.solve(pb, max_limit=50)
+        assert batched.placements == seq.placements
+        assert batched.fail_type == seq.fail_type == sim.FAIL_UNSCHEDULABLE
+        assert batched.fail_message == seq.fail_message
+
+
+def test_sweep_behavioral_dedup_exactness():
+    """Templates identical up to their own (self-referential) names dedup to
+    one solve — but a label that an EXISTING pod's selector references must
+    keep its template in a separate class."""
+    nodes = [build_test_node(f"n{i}", 8000, 32 * 1024 ** 3, 110,
+                             labels={"kubernetes.io/hostname": f"n{i}"})
+             for i in range(4)]
+    anchor = build_test_pod("anchor", 10, 10, node_name="n2",
+                            labels={"role": "anchor"})
+    anchor["spec"]["affinity"] = {"podAffinity": {
+        "requiredDuringSchedulingIgnoredDuringExecution": [{
+            "topologyKey": "kubernetes.io/hostname",
+            "labelSelector": {"matchLabels": {"app": "magnet"}}}]}}
+    snapshot = ClusterSnapshot.from_objects(
+        nodes, [anchor], namespaces=[{"metadata": {"name": "default"}}])
+    profile = SchedulerProfile.parity()
+    # t0/t1: identical behavior, different names; t2: matches the anchor's
+    # affinity selector -> scores differently
+    templates = [
+        default_pod(build_test_pod("t0", 100, 1024 ** 3,
+                                   labels={"app": "t0"})),
+        default_pod(build_test_pod("t1", 100, 1024 ** 3,
+                                   labels={"app": "t1"})),
+        default_pod(build_test_pod("t2", 100, 1024 ** 3,
+                                   labels={"app": "magnet"})),
+    ]
+    swept = sweep(snapshot, templates, profile=profile, max_limit=4)
+    for t, got in zip(templates, swept):
+        pb = enc.encode_problem(snapshot, t, profile)
+        seq = sim.solve(pb, max_limit=4)
+        assert got.placements == seq.placements, t["metadata"]["name"]
+    # t2 must be pulled toward the anchor's node (HardPodAffinityWeight)
+    assert swept[2].placements[0] == 2
+    assert swept[0].placements == swept[1].placements
+    assert swept[0].placements != swept[2].placements
+
+
 def test_sweep_queue_sort_alignment():
     """queue_sort solves in PrioritySort order but returns results aligned
     with the input template order."""
