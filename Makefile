@@ -7,7 +7,7 @@ CXX ?= g++
 CXXFLAGS ?= -O2 -std=c++17 -fPIC -Wall
 NATIVE_LIB := cluster_capacity_tpu/models/libccsnap.so
 
-.PHONY: all build native lint test-unit test-parity test-fuzz test-dist test-integration test-e2e bench clean verify-native
+.PHONY: all build native lint test-unit test-parity test-fuzz test-dist test-integration test-e2e bench clean verify-native ci
 
 all: build
 
@@ -58,6 +58,12 @@ test-e2e:
 
 bench:
 	$(PY) bench.py
+
+# Full CI pipeline: lint + native + default suite + fuzz slice +
+# integration + multichip dryrun, as configured in ci.yaml (the
+# cloudbuild.yaml analog; tools/ci.py is the local step runner).
+ci:
+	$(PY) tools/ci.py
 
 verify-native: native
 	$(PY) -m pytest tests/test_native.py -q

@@ -14,11 +14,15 @@ so each queue pop is pure elementwise/reduction work on device.
 Scope (everything else falls back to the object path, which stays the
 differential oracle for this engine — tests/test_interleave_tensor.py):
 
-- deterministic profiles without extenders (extender webhooks are
-  host-synchronous by nature);
-- preemption must be structurally impossible: equal template priorities and
-  no existing pod below them (then DefaultPreemption can never produce a
-  victim, and the object path's preemption machinery is dead weight);
+- deterministic profiles; extenders ARE supported (r5, VERDICT r4 #4):
+  their Filter/Prioritize verdicts are treated as per-(template, node)
+  deterministic — called ONCE per template over the full node axis, the
+  mask/bonus ride the device step (the object path sends the same template
+  pod every cycle, so a deterministic webhook answers identically; a
+  stateful/verdict-varying extender needs the object path).  Bind verbs
+  fire at chunk boundaries in placement order;
+- preemption and priority tiers run natively (tier-ranked pops on device,
+  victim selection as a rare host event between chunks);
 - templates must share one jit specialization (sweep._group_key) and the
   snapshot resource vocabulary; clone self-conflict gates (host ports,
   inline disks, RWOP, shared DRA claims) stay on the object path.
@@ -249,12 +253,12 @@ def eligible_profile(snapshot: ClusterSnapshot, templates: Sequence[dict],
                      profile: SchedulerProfile) -> Optional[str]:
     """Profile gates checkable BEFORE the O(T*N) encode pass.  Priority
     tiers and preemption are handled natively (tier-ranked pops on device;
-    victim selection as a rare host event between chunks), so they no
-    longer force the object path (VERDICT r3 #5)."""
+    victim selection as a rare host event between chunks, VERDICT r3 #5);
+    extenders run as one static host round per template (VERDICT r4 #4)."""
     if not profile.deterministic:
         return "non-deterministic tie-break"
-    if profile.extenders:
-        return "extenders are host-synchronous"
+    if profile.extenders and not profile.tensor_extenders:
+        return "profile declares stateful extenders (tensor_extenders=False)"
     if profile.include_preemption_message:
         return "preemption message formatting needs the object path"
     return None
@@ -361,12 +365,23 @@ def _xstep(cfg: sim.StaticConfig, sconsts, xconsts, xc: XCarry):
                                        eanti_dyn=_idx(xc.eanti_cnt, t))
     any_feasible = jnp.any(feasible)
     scorable, new_ns = sim._sample_scorable(cfg, feasible, xc.next_start[t])
-    total = sim._scores(cfg, c_t, view, scorable)
-    keyed = jnp.where(scorable, total, jnp.asarray(-1.0, dt))
+    # extender Filter applies to the SAMPLED window, after the in-tree
+    # filters (findNodesThatFitPod order, schedule_one.go:482-565); the
+    # Prioritize bonus is ADDED to the plugin sum without normalization
+    # (schedule_one.go:819-877).  Both are static per (template, node).
+    scorable = scorable & _idx(xconsts["ext_mask"], t)
+    any_scorable = jnp.any(scorable)
+    total = sim._scores(cfg, c_t, view, scorable) \
+        + _idx(xconsts["ext_bonus"], t)
+    # -inf sentinel: extender bonuses may push totals negative
+    keyed = jnp.where(scorable, total, -jnp.inf)
     chosen = jnp.argmax(keyed).astype(jnp.int32)
 
-    do = live & any_feasible
-    fails = live & ~any_feasible
+    do = live & any_scorable
+    fails = live & ~any_scorable
+    # the object path advances the sampling rotation BEFORE the extender
+    # filter, so an extender-emptied window still rotates
+    ext_failed = fails & any_feasible
     # Device-side curability (mirrors diagnose()'s first-fail attribution):
     # a failure is pod-ADD-curable when SOME node's first failing class is
     # one another pod can change — static port conflicts, spread, or
@@ -458,7 +473,8 @@ def _xstep(cfg: sim.StaticConfig, sconsts, xconsts, xc: XCarry):
     last_seq = jnp.where(onehot_t & do, xc.seq_next + 1, last_seq)
     seq_next = xc.seq_next + 2 * do.astype(jnp.int32)
     k = xc.k + (onehot_t & do).astype(jnp.int32)
-    next_start = jnp.where(onehot_t & do, new_ns, xc.next_start)
+    next_start = jnp.where(onehot_t & (do | ext_failed), new_ns,
+                           xc.next_start)
 
     out = XCarry(
         requested=requested, nonzero=nonzero, placed=placed,
@@ -551,6 +567,31 @@ def solve_interleaved_tensor(snapshot: ClusterSnapshot,
     preempt_capable = bool(preempt_on and maybe.any())
     preempt_budget = 10 * t_n + 100       # eviction valve (sweep_interleaved)
 
+    # One static extender round per template (VERDICT r4 #4): Filter over
+    # the full node axis -> bool mask, Prioritize -> bonus vector.  Node
+    # objects never change during a study (evictions only touch pods), so
+    # the verdicts survive rebuilds.  The object path filters the sampled
+    # window each cycle with the same template pod — identical for
+    # deterministic per-(pod, node) extenders (module contract above).
+    extenders = list(profile.extenders or [])
+    has_binder = any(e.is_binder for e in extenders)
+    ext_mask_np = np.ones((t_n, n), dtype=bool)
+    ext_bonus_np = np.zeros((t_n, n), dtype=np.float64)
+    if extenders:
+        from ..engine.extenders import (run_filter_chain,
+                                        run_prioritize_chain)
+        node_objs = {nm: o for nm, o in zip(snapshot.node_names,
+                                            snapshot.nodes)}
+        all_names = list(snapshot.node_names)
+        for ti, t in enumerate(solve_templates):
+            surviving = set(run_filter_chain(extenders, t, all_names,
+                                             node_objs))
+            ext_mask_np[ti] = np.asarray(
+                [nm in surviving for nm in all_names], dtype=bool)
+            bonus = run_prioritize_chain(extenders, t, all_names)
+            ext_bonus_np[ti] = np.asarray(
+                [bonus.get(nm, 0.0) for nm in all_names])
+
     def encode_group(snap):
         """(pbs, cfg, dnh, consts_list, sconsts, xconsts) for the CURRENT
         snapshot — rebuilt after every eviction round, exactly like the
@@ -575,6 +616,8 @@ def solve_interleaved_tensor(snapshot: ClusterSnapshot,
             "tier_rank": jnp.asarray(tier_rank),
             "preempt_maybe": jnp.asarray(
                 maybe if preempt_on else np.zeros(t_n, dtype=bool)),
+            "ext_mask": jnp.asarray(ext_mask_np),
+            "ext_bonus": f(ext_bonus_np),
             **{k: f(v) for k, v in _ipa_xinc(pbs).items()},
         }
         return pbs, cfg, dnh, consts_list, sconsts, xconsts, dt
@@ -635,6 +678,17 @@ def solve_interleaved_tensor(snapshot: ClusterSnapshot,
     def park_result(ti: int):
         counts = sim.diagnose(pbs[ti], cfg, consts_list[ti], view_of(ti),
                               eanti_dyn=xc.eanti_cnt[ti])
+        if extenders:
+            # nodes the in-tree filters accept can only have been lost to
+            # the extender Filter chain — the object path attributes the
+            # whole in-tree-feasible set to that bucket
+            feas, _ = sim._feasibility(cfg, consts_list[ti], view_of(ti),
+                                       eanti_dyn=xc.eanti_cnt[ti])
+            n_feas = int(np.asarray(feas).sum())
+            if n_feas:
+                counts = dict(counts)
+                from ..engine.extenders import REASON_EXTENDER_FILTER
+                counts[REASON_EXTENDER_FILTER] = n_feas
         results[solve_idx[ti]] = sim.SolveResult(
             placements=list(placements[ti]),
             placed_count=len(placements[ti]),
@@ -675,12 +729,16 @@ def solve_interleaved_tensor(snapshot: ClusterSnapshot,
         tier.  Returns True when an eviction happened."""
         nonlocal snap_cur, pbs, cfg, dnh, consts_list, sconsts, xconsts, \
             xc, preempt_budget, front_seq, budget
+        from ..engine.extenders import make_node_ok
         from ..engine.preemption import evaluate as preempt_evaluate
         from ..engine.preemption import victim_matcher
         from ..models import snapshot as snapshot_mod
 
-        outcome = preempt_evaluate(snap_cur, pods_by_node_cur,
-                                   solve_templates[ti], profile)
+        outcome = preempt_evaluate(
+            snap_cur, pods_by_node_cur, solve_templates[ti], profile,
+            node_ok=make_node_ok(extenders, solve_templates[ti],
+                                 snapshot.node_names, snapshot.nodes),
+            extenders=extenders)
         if not (outcome.succeeded and outcome.victims):
             return False
         preempt_budget -= 1
@@ -745,12 +803,20 @@ def solve_interleaved_tensor(snapshot: ClusterSnapshot,
             if t_i >= 0:
                 placements[t_i].append(ch_i)
                 total += 1
-                if preempt_capable:
+                if preempt_capable or has_binder:
                     clone = ps.make_clone(solve_templates[t_i],
                                           len(placements[t_i]) - 1)
                     clone["spec"]["nodeName"] = snapshot.node_names[ch_i]
-                    pods_by_node_cur[ch_i].append(clone)
-                    dirty_nodes.add(ch_i)
+                    if has_binder:
+                        # chunk-boundary bind drain, in placement order
+                        # (sweep_interleaved binds the clone per cycle; a
+                        # bind error propagates exactly like there)
+                        from ..engine.extenders import run_bind
+                        run_bind(extenders, clone,
+                                 snapshot.node_names[ch_i])
+                    if preempt_capable:
+                        pods_by_node_cur[ch_i].append(clone)
+                        dirty_nodes.add(ch_i)
         steps_done += CHUNK
         if bool(np.asarray(xc.halt)):
             ti = int(np.asarray(xc.halt_ti))

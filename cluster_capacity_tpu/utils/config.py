@@ -108,6 +108,13 @@ class SchedulerProfile:
     # Scheduler extenders (HTTP webhooks or injected callables); when set the
     # solve runs the host-driven extender loop (engine/extenders.py).
     extenders: List = field(default_factory=list)
+    # Interleaved studies run extenders on the tensor engine by default,
+    # which assumes verdicts are deterministic per (pod, node) — one static
+    # Filter/Prioritize round per template.  Set False for stateful or
+    # call-order-sensitive webhooks (e.g. a capacity-tracking binder that
+    # changes Filter answers as binds land): the study then runs the
+    # object-level queue loop, which calls the webhook every cycle.
+    tensor_extenders: bool = True
     # NodeAffinityArgs.addedAffinity: extra required node affinity applied to
     # every pod of the profile (node_affinity.go args).
     added_affinity: Optional[dict] = None

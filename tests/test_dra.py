@@ -2,6 +2,8 @@
 pools from ResourceSlices, per-clone claim templates, shared-claim
 colocation, missing-object pod-level failures."""
 
+import pytest
+
 from cluster_capacity_tpu import ClusterCapacity, SchedulerProfile
 from cluster_capacity_tpu.models.podspec import default_pod
 
@@ -430,10 +432,11 @@ def test_cel_expression_length_capped():
 
 
 def test_counter_pool_count_matches_linear_probe():
-    """With shared counters, the slot count must be a feasible greedy
-    count (the production rescue probes exponentially, so in general it
-    is >= the binary-search floor and <= the best linear-scan k; for
-    this fixture all three coincide at 2)."""
+    """With shared counters, the slot count must equal the best feasible k
+    from a direct downward scan.  Through r4 this fixture answered 2 (the
+    greedy lower bound: first-fit grabs the 30Gi partition and strands the
+    pool); the r5 exact backtracking allocator finds the true 4 x 10Gi
+    assignment."""
     from cluster_capacity_tpu.ops.dynamic_resources import _fits_k_clones
     nodes = [build_test_node("n1", 100000, int(1e11), 500)]
     # heterogeneous partitions: big ones starve the pool for later clones
@@ -459,11 +462,7 @@ def test_counter_pool_count_matches_linear_probe():
         if _fits_k_clones(k, units, 5, consumes, pools):
             best = k
             break
-    # greedy first-fit grabs the 30Gi partition first, so its best is 2 —
-    # a lower bound on the backtracking answer (4 x 10Gi).  The slot
-    # column must agree with the direct downward scan, not a
-    # binary-search artifact.
-    assert best == 2
+    assert best == 4
     assert res.placed_count == best
 
 
@@ -551,3 +550,136 @@ def test_shared_structured_claim_plus_template_claim():
     # 4 matching devices: 1 reserved by the shared allocation -> 3 clones
     assert res.placed_count == 3
     assert res.fail_counts.get("cannot allocate all claims") == 1
+
+
+# --- sharedCounters exactness (r5: backtracking replaces the greedy bound) -
+
+def test_partitionable_greedy_stranding_exact():
+    """The canonical greedy-failure family (VERDICT r4 #3): first-fit hands
+    the counter-hungry partition to the first clone and strands the pool.
+    Pool 20Gi; partitions big{20Gi}, small1{10Gi}, small2{10Gi}: greedy
+    takes `big` (device order) and answers 1 clone — the exact backtracking
+    search allocates small1+small2 for the true maximum of 2."""
+    nodes = [build_test_node("n1", 100000, int(1e11), 500)]
+    devices = [
+        {"name": "big",
+         "consumesCounters": [{"counterSet": "gpu0",
+                               "counters": {"memory": {"value": "20Gi"}}}]},
+        {"name": "small1",
+         "consumesCounters": [{"counterSet": "gpu0",
+                               "counters": {"memory": {"value": "10Gi"}}}]},
+        {"name": "small2",
+         "consumesCounters": [{"counterSet": "gpu0",
+                               "counters": {"memory": {"value": "10Gi"}}}]},
+    ]
+    counters = [{"name": "gpu0", "counters": {"memory": {"value": "20Gi"}}}]
+    tmpl = _sel_template("part", count=1)
+    res = _run_dra(_pod_with_template_claim("p", "part"), nodes,
+                   resource_slices=[_attr_slice("n1", devices,
+                                                counters=counters)],
+                   resource_claim_templates=[tmpl])
+    assert res.placed_count == 2
+    assert res.fail_counts.get("cannot allocate all claims") == 1
+
+
+def _brute_max_clones(units_per_clone, consumes, pools, n_devices):
+    """Exhaustive oracle: max k such that k clones' units all get distinct
+    eligible devices under the counter pools."""
+    from itertools import permutations
+
+    def feasible(units):
+        u = len(units)
+        if u > n_devices:
+            return False
+        for perm in permutations(range(n_devices), u):
+            if any(perm[i] not in units[i] for i in range(u)):
+                continue
+            rem = dict(pools)
+            ok = True
+            for d in perm:
+                for key, v in consumes[d].items():
+                    rem[key] = rem.get(key, 0) - v
+                    if rem[key] < -1e-9:
+                        ok = False
+                        break
+                if not ok:
+                    break
+            if ok:
+                return True
+        return False
+
+    k = 0
+    while k < n_devices and feasible(units_per_clone * (k + 1)):
+        k += 1
+    return k
+
+
+@pytest.mark.parametrize("seed", range(40))
+def test_fits_k_clones_exact_vs_bruteforce(seed):
+    """Random partitionable-device configs: the binary search over
+    _fits_k_clones (greedy fast-accept + backtracking settle) must equal
+    the exhaustive oracle."""
+    import numpy as np
+    from cluster_capacity_tpu.ops import dynamic_resources as dra
+
+    rng = np.random.RandomState(8000 + seed)
+    n_dev = int(rng.randint(1, 6))
+    pools = {("s", "c0"): int(rng.randint(0, 5))}
+    if rng.rand() < 0.5:
+        pools[("s", "c1")] = int(rng.randint(0, 5))
+    consumes = []
+    for _ in range(n_dev):
+        c = {}
+        for key in pools:
+            if rng.rand() < 0.7:
+                c[key] = int(rng.randint(0, 4))
+        consumes.append(c)
+    n_units = int(rng.randint(1, 3))
+    units = [[d for d in range(n_dev) if rng.rand() < 0.8]
+             for _ in range(n_units)]
+
+    cap = n_dev // max(1, n_units)
+    lo, hi = 0, cap
+    while lo < hi:
+        mid = (lo + hi + 1) // 2
+        if dra._fits_k_clones(mid, units, n_dev, consumes, pools):
+            lo = mid
+        else:
+            hi = mid - 1
+    brute = _brute_max_clones([set(u) for u in units], consumes, pools,
+                              n_dev)
+    assert lo == brute, (seed, units, consumes, pools)
+
+
+def test_shared_claim_joint_exactness_with_counters():
+    """A shared structured claim must be searched JOINTLY with the clone
+    units: pool c=2 with devices A{c:2}, B{c:1}, C{c:1} — a greedy shared
+    reservation takes A and drains the pool (0 clones); the joint
+    backtracking places the shared claim on B and one clone on C."""
+    nodes = [build_test_node("n1", 100000, int(1e11), 500)]
+    devices = [
+        {"name": "A",
+         "consumesCounters": [{"counterSet": "s",
+                               "counters": {"c": {"value": "2"}}}]},
+        {"name": "B",
+         "consumesCounters": [{"counterSet": "s",
+                               "counters": {"c": {"value": "1"}}}]},
+        {"name": "C",
+         "consumesCounters": [{"counterSet": "s",
+                               "counters": {"c": {"value": "1"}}}]},
+    ]
+    counters = [{"name": "s", "counters": {"c": {"value": "2"}}}]
+    claim = _shared_claim()
+    tmpl = _sel_template("clone-dev")
+    pod = build_test_pod("p", 100, 0)
+    pod["spec"]["resourceClaims"] = [
+        {"name": "shared-dev", "resourceClaimName": "shared"},
+        {"name": "own-dev", "resourceClaimTemplateName": "clone-dev"}]
+    cc = ClusterCapacity(default_pod(pod), profile=SchedulerProfile.parity())
+    cc.sync_with_objects(nodes,
+                         resource_slices=[_attr_slice("n1", devices,
+                                                      counters=counters)],
+                         resource_claims=[claim],
+                         resource_claim_templates=[tmpl])
+    res = cc.run()
+    assert res.placed_count == 1

@@ -242,13 +242,8 @@ def test_fallback_reasons():
     # priorities differing no longer falls back (tier-ranked pops are
     # native, VERDICT r3 #5) — covered differentially below
 
-    # extenders → object path
-    from cluster_capacity_tpu.engine.extenders import ExtenderConfig
-    prof2 = SchedulerProfile.parity()
-    prof2.extenders = [ExtenderConfig(
-        filter_callable=lambda p, names: {"NodeNames": names})]
-    assert il.solve_interleaved_tensor(snap, [_template("a", 300)],
-                                       prof2) is None
+    # extenders no longer fall back (r5, VERDICT r4 #4): one static host
+    # round per template — covered differentially below
 
     # host ports → object path
     port = _template("p", 300)
@@ -395,3 +390,182 @@ def test_fuzz_tiered_preemption_corpus(seed):
     _assert_same(sweep_interleaved(snap, ts, prof),
                  il.solve_interleaved_tensor(snap, ts, prof),
                  f"tier-fuzz-{seed}")
+
+
+# --------------------------------------------------------------------------
+# extender host-callback rounds (r5, VERDICT r4 #4)
+# --------------------------------------------------------------------------
+
+def _http_extender_server(filter_fn=None, prioritize_fn=None,
+                          with_bind=False):
+    """Tiny local HTTP extender (extender/v1 payload shapes); returns
+    (ExtenderConfig, calls, shutdown)."""
+    import json
+    import threading
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    from cluster_capacity_tpu.engine.extenders import ExtenderConfig
+
+    calls = []
+
+    class H(BaseHTTPRequestHandler):
+        def do_POST(self):
+            body = json.loads(self.rfile.read(
+                int(self.headers["Content-Length"])).decode())
+            verb = self.path.rsplit("/", 1)[-1]
+            calls.append((verb, body))
+            if verb == "filter":
+                names = body.get("NodeNames") or []
+                out = {"NodeNames": filter_fn(body["Pod"], names)
+                       if filter_fn else list(names)}
+            elif verb == "prioritize":
+                names = body.get("NodeNames") or []
+                out = [{"Host": n,
+                        "Score": prioritize_fn(body["Pod"], n)
+                        if prioritize_fn else 0}
+                       for n in names]
+            elif verb == "bind":
+                out = {}
+            else:
+                out = {"Error": f"unknown verb {verb}"}
+            payload = json.dumps(out).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def log_message(self, *a):
+            pass
+
+    srv = HTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    cfg = ExtenderConfig(
+        url_prefix=f"http://127.0.0.1:{srv.server_port}/scheduler",
+        filter_verb="filter", prioritize_verb="prioritize",
+        bind_verb="bind" if with_bind else "", weight=10,
+        node_cache_capable=True)
+
+    def shutdown():
+        srv.shutdown()
+        srv.server_close()
+    return cfg, calls, shutdown
+
+
+def test_extender_http_mix_matches_object_path():
+    """Mixed spread/plain corpus through a REAL HTTP extender (filter drops
+    even-numbered nodes; prioritize favors zone z1): the tensor engine's
+    static per-template rounds must reproduce the object path's per-cycle
+    webhook calls placement-for-placement, including the extender-filter
+    FitError bucket when the filter empties a template's window."""
+    snap = ClusterSnapshot.from_objects(_nodes(9))
+
+    def filt(pod, names):
+        # drop even nodes for template "b" only; keep all for others
+        if (pod.get("metadata") or {}).get("name") == "b":
+            return [n for n in names if int(n[1:]) % 2 == 1]
+        return list(names)
+
+    def prio(pod, name):
+        return 3 if int(name[1:]) % 3 == 1 else 0
+
+    cfg, calls, shutdown = _http_extender_server(filt, prio)
+    try:
+        prof_ref = SchedulerProfile.parity()
+        prof_ref.extenders = [cfg]
+        ts = [_template("a", 600, spread=(1, "topology.kubernetes.io/zone",
+                                          {"app": "a"})),
+              _template("b", 450), _template("c", 700)]
+        ref = sweep_interleaved(snap, ts, prof_ref)
+        got = il.solve_interleaved_tensor(snap, ts, prof_ref)
+        _assert_same(ref, got, "http-ext")
+    finally:
+        shutdown()
+
+
+def test_extender_empties_window_parks_with_bucket():
+    """An extender rejecting EVERY node for one template parks it with the
+    extender-filter bucket while other templates keep placing — both paths
+    agree."""
+    snap = ClusterSnapshot.from_objects(_nodes(6))
+
+    def filt(pod, names):
+        if (pod.get("metadata") or {}).get("name") == "blocked":
+            return []
+        return list(names)
+
+    cfg, calls, shutdown = _http_extender_server(filt)
+    try:
+        prof = SchedulerProfile.parity()
+        prof.extenders = [cfg]
+        ts = [_template("blocked", 100), _template("free", 500)]
+        ref = sweep_interleaved(snap, ts, prof)
+        got = il.solve_interleaved_tensor(snap, ts, prof)
+        _assert_same(ref, got, "ext-blocked")
+        from cluster_capacity_tpu.engine.extenders import (
+            REASON_EXTENDER_FILTER)
+        assert got[0].placed_count == 0
+        assert got[0].fail_counts.get(REASON_EXTENDER_FILTER) == 6
+        assert got[1].placed_count > 0
+    finally:
+        shutdown()
+
+
+def test_extender_bind_drain_order():
+    """Binder extenders fire once per placement, in placement order, with
+    the clone (not the template) as the payload."""
+    snap = ClusterSnapshot.from_objects(_nodes(4))
+    cfg, calls, shutdown = _http_extender_server(with_bind=True)
+    try:
+        prof = SchedulerProfile.parity()
+        prof.extenders = [cfg]
+        ts = [_template("a", 900), _template("b", 700)]
+        got = il.solve_interleaved_tensor(snap, ts, prof, max_total=6)
+        assert got is not None
+        binds = [b for v, b in calls if v == "bind"]
+        assert len(binds) == sum(r.placed_count for r in got) == 6
+        # clone names carry the per-clone suffix, alternating a/b pops
+        assert binds[0]["PodName"].startswith("a-")
+        assert binds[1]["PodName"].startswith("b-")
+    finally:
+        shutdown()
+
+
+def test_extender_callable_with_priority_tiers_and_preemption():
+    """Callable extenders compose with native tiers + preemption: a
+    high-priority template preempts through the extender-vetted candidate
+    set; both engines agree."""
+    from cluster_capacity_tpu.engine.extenders import ExtenderConfig
+    snap = ClusterSnapshot.from_objects(
+        _nodes(5, pods=2),
+        priority_classes=[{"metadata": {"name": "high"}, "value": 1000}])
+
+    def filt(pod, names):
+        return {"NodeNames": [n for n in names if n != "n000"]}
+
+    prof = SchedulerProfile.parity()
+    prof.extenders = [ExtenderConfig(filter_callable=filt)]
+    hi = _template("hi", 300)
+    hi["spec"]["priorityClassName"] = "high"
+    hi["spec"]["priority"] = 1000
+    lo = _template("lo", 300)
+    lo["spec"]["priority"] = 0
+    ref = sweep_interleaved(snap, [hi, lo], prof)
+    got = il.solve_interleaved_tensor(snap, [hi, lo], prof)
+    _assert_same(ref, got, "ext-tiers")
+
+
+def test_tensor_extenders_opt_out():
+    """profile.tensor_extenders=False routes extender studies to the
+    object path (the escape hatch for stateful webhooks)."""
+    from cluster_capacity_tpu.engine.extenders import ExtenderConfig
+    snap = ClusterSnapshot.from_objects(_nodes(4))
+    prof = SchedulerProfile.parity()
+    prof.extenders = [ExtenderConfig(
+        filter_callable=lambda p, names: {"NodeNames": list(names)})]
+    prof.tensor_extenders = False
+    assert il.solve_interleaved_tensor(snap, [_template("a", 300)],
+                                       prof) is None
+    res = il.sweep_interleaved_auto(snap, [_template("a", 300)], prof,
+                                    max_total=3)
+    assert res[0].placed_count == 3

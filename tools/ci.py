@@ -1,0 +1,85 @@
+"""Execute the ci.yaml pipeline: ordered steps, per-step timeout, fail fast.
+
+The reference delegates CI to Cloud Build (cloudbuild.yaml) + prow's
+verify/ scripts; this tree has no hosted runner, so the pipeline config is
+executed locally by this ~80-line runner (`make ci`).  Exit code 0 iff all
+steps pass; each step's wall time is printed so regressions in suite cost
+are visible in CI logs round over round.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_steps(path: str):
+    """Minimal YAML subset reader for ci.yaml (no yaml dep needed in
+    minimal images; falls back to PyYAML when present for robustness)."""
+    try:
+        import yaml
+        with open(path) as f:
+            doc = yaml.safe_load(f)
+        return doc.get("steps", []), int(doc.get("timeout", 3600))
+    except ImportError:
+        pass
+    steps, total, cur = [], 3600, None
+    for raw in open(path):
+        line = raw.split("#", 1)[0].rstrip()
+        if not line.strip():
+            continue
+        if line.startswith("timeout:") and cur is None:
+            total = int(line.split(":", 1)[1])
+        elif line.strip().startswith("- name:"):
+            cur = {"name": line.split(":", 1)[1].strip()}
+            steps.append(cur)
+        elif cur is not None and line.strip().startswith("run:"):
+            cur["run"] = line.split(":", 1)[1].strip()
+            cur["_run_cont"] = True
+        elif cur is not None and line.strip().startswith("timeout:"):
+            cur["timeout"] = int(line.split(":", 1)[1])
+            cur.pop("_run_cont", None)
+        elif cur is not None and cur.get("_run_cont"):
+            cur["run"] += " " + line.strip()
+    for s in steps:
+        s.pop("_run_cont", None)
+    return steps, total
+
+
+def main() -> int:
+    cfg = os.path.join(REPO, "ci.yaml")
+    steps, total_timeout = _load_steps(cfg)
+    if not steps:
+        print("ci: no steps in ci.yaml", file=sys.stderr)
+        return 2
+    t_start = time.time()
+    for i, step in enumerate(steps, 1):
+        name = step.get("name", f"step-{i}")
+        cmd = step["run"]
+        timeout = min(int(step.get("timeout", 1800)),
+                      max(1, int(total_timeout - (time.time() - t_start))))
+        print(f"[ci] {i}/{len(steps)} {name}: {cmd}", flush=True)
+        t0 = time.time()
+        try:
+            r = subprocess.run(shlex.split(cmd), cwd=REPO, timeout=timeout)
+        except subprocess.TimeoutExpired:
+            print(f"[ci] {name} TIMED OUT after {timeout}s", flush=True)
+            return 1
+        dt = time.time() - t0
+        if r.returncode != 0:
+            print(f"[ci] {name} FAILED rc={r.returncode} ({dt:.0f}s)",
+                  flush=True)
+            return 1
+        print(f"[ci] {name} ok ({dt:.0f}s)", flush=True)
+    print(f"[ci] all {len(steps)} steps passed "
+          f"({time.time() - t_start:.0f}s total)", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
