@@ -32,6 +32,7 @@ _KIND_TO_KEY = {
     "ReplicaSet": "replica_sets",
     "StatefulSet": "stateful_sets",
     "StorageClass": "storage_classes",
+    "CSIStorageCapacity": "csistoragecapacities",
     "Namespace": "namespaces",
     "LimitRange": "limit_ranges",
     "PriorityClass": "priority_classes",

@@ -28,12 +28,15 @@ REDUCED = {"profile": {"score_weights": {"NodeResourcesFit": 1}},
 
 
 def scenario(name, description, derivation, nodes, pod, expected,
-             profile_block=PARITY, max_limit=0, pods=None):
+             profile_block=PARITY, max_limit=0, pods=None,
+             snapshot_extra=None):
     data = {"description": description, "derivation": derivation}
     data.update(profile_block)
     snapshot = {"nodes": nodes}
     if pods:
         snapshot["pods"] = pods
+    if snapshot_extra:
+        snapshot.update(snapshot_extra)
     data.update({"max_limit": max_limit, "snapshot": snapshot,
                  "pod": pod, "expected": expected})
     path = os.path.join(HERE, f"{name}.json")
@@ -438,6 +441,206 @@ def main():
                                "topologyKey": "topology.kubernetes.io/zone",
                                "labelSelector": {
                                    "matchLabels": {"app": "x"}}}}]}}}}])
+    _wffc_ipa_scenarios()
+
+
+def _wffc_ipa_scenarios():
+    """Round-5 corpus growth (VERDICT r4 #6): VolumeBinding WFFC +
+    CSIStorageCapacity edges (volume_binding.go:417-569, binder.go
+    checkVolumeProvisions/hasEnoughCapacity) and InterPodAffinity
+    namespaceSelector asymmetries (scoring.go:128-293)."""
+
+    def znode(name, zone, pods, cpu=2000):
+        return build_test_node(
+            name, cpu, 64 * 1024 ** 3, pods,
+            labels={"kubernetes.io/hostname": name,
+                    "topology.kubernetes.io/zone": zone})
+
+    def wffc_sc(allowed_zones=None):
+        sc = {"metadata": {"name": "fast-wffc"},
+              "provisioner": "ebs.csi.example.com",
+              "volumeBindingMode": "WaitForFirstConsumer"}
+        if allowed_zones:
+            sc["allowedTopologies"] = [{"matchLabelExpressions": [{
+                "key": "topology.kubernetes.io/zone",
+                "values": list(allowed_zones)}]}]
+        return sc
+
+    def capacity(name, zone, cap, max_size=None):
+        out = {"metadata": {"name": name},
+               "storageClassName": "fast-wffc",
+               "nodeTopology": {"matchLabels": {
+                   "topology.kubernetes.io/zone": zone}},
+               "capacity": cap}
+        if max_size:
+            out["maximumVolumeSize"] = max_size
+        return out
+
+    pvc10 = {"metadata": {"name": "data", "namespace": "default"},
+             "spec": {"storageClassName": "fast-wffc",
+                      "accessModes": ["ReadWriteOnce"],
+                      "resources": {"requests": {"storage": "10Gi"}}}}
+
+    def claim_pod(cpu="500m"):
+        return {"metadata": {"name": "w", "labels": {"app": "w"},
+                             "namespace": "default"},
+                "spec": {"containers": [{"name": "c", "resources": {
+                    "requests": {"cpu": cpu}}}],
+                    "volumes": [{"name": "v", "persistentVolumeClaim": {
+                        "claimName": "data"}}]}}
+
+    scenario(
+        "wffc_capacity_zone_split",
+        "binder.go hasEnoughCapacity: the driver publishes "
+        "CSIStorageCapacity ONLY for z1, so z0 nodes cannot provision the "
+        "10Gi WFFC claim ('node(s) did not have enough free storage') and "
+        "every clone lands in z1.  Reduced fit-only profile: n2/n3 tie -> "
+        "lowest index n2; LeastAllocated then alternates as usage grows.  "
+        "pods-per-node 3 binds before cpu (2000m/500m=4): 6 placements "
+        "[n2 n3 n2 n3 n2 n3], then z1 nodes fail 'Too many pods'",
+        "manual-arithmetic",
+        [znode("n0", "z0", 3), znode("n1", "z0", 3),
+         znode("n2", "z1", 3), znode("n3", "z1", 3)],
+        claim_pod(),
+        {"placed_count": 6,
+         "placements": ["n2", "n3", "n2", "n3", "n2", "n3"],
+         "per_node_counts": {"n2": 3, "n3": 3},
+         "fail_type": "Unschedulable",
+         "fail_message_contains": "did not have enough free storage"},
+        profile_block=REDUCED,
+        snapshot_extra={"storage_classes": [wffc_sc()],
+                        "csistoragecapacities": [
+                            capacity("cap-z1", "z1", "100Gi")],
+                        "pvcs": [pvc10]})
+
+    scenario(
+        "wffc_maximum_volume_size",
+        "binder.go hasEnoughCapacity maximumVolumeSize: z1's capacity "
+        "object covers 100Gi total but caps single volumes at 5Gi < the "
+        "10Gi claim, so z1 cannot provision; z0 (50Gi, no max) can.  Both "
+        "clones land on n0 (pods-per-node 2), then n0 fails 'Too many "
+        "pods' and n1 keeps the storage reason",
+        "manual-arithmetic",
+        [znode("n0", "z0", 2), znode("n1", "z1", 2)],
+        claim_pod(),
+        {"placed_count": 2, "placements": ["n0", "n0"],
+         "fail_type": "Unschedulable",
+         "fail_message_contains": "did not have enough free storage"},
+        profile_block=REDUCED,
+        snapshot_extra={"storage_classes": [wffc_sc()],
+                        "csistoragecapacities": [
+                            capacity("cap-z0", "z0", "50Gi"),
+                            capacity("cap-z1", "z1", "100Gi",
+                                     max_size="5Gi")],
+                        "pvcs": [pvc10]})
+
+    scenario(
+        "wffc_allowed_topologies_vs_capacity",
+        "checkVolumeProvisions: StorageClass.allowedTopologies admits "
+        "z0+z1 (z2 -> 'node(s) didn't find available persistent volumes "
+        "to bind'); capacity is published for z1+z2 only (z0 -> 'not "
+        "enough free storage').  The intersection is n1/z1: both clones "
+        "land there (pods-per-node 2)",
+        "manual-arithmetic",
+        [znode("n0", "z0", 2), znode("n1", "z1", 2), znode("n2", "z2", 2)],
+        claim_pod(),
+        {"placed_count": 2, "placements": ["n1", "n1"],
+         "fail_type": "Unschedulable",
+         "fail_message_contains":
+             "didn't find available persistent volumes to bind"},
+        profile_block=REDUCED,
+        snapshot_extra={"storage_classes": [wffc_sc(("z0", "z1"))],
+                        "csistoragecapacities": [
+                            capacity("cap-z1", "z1", "100Gi"),
+                            capacity("cap-z2", "z2", "100Gi")],
+                        "pvcs": [pvc10]})
+
+    # --- InterPodAffinity namespaceSelector asymmetries -------------------
+    ns_objects = [{"metadata": {"name": "default", "labels": {}}},
+                  {"metadata": {"name": "team-a",
+                                "labels": {"team": "a"}}}]
+    two_zone = [znode("n0", "z0", 2), znode("n1", "z1", 2)]
+
+    def web_pod(name, ns, node, affinity=None):
+        pod = {"metadata": {"name": name, "namespace": ns,
+                            "labels": {"app": "web"}},
+               "spec": {"nodeName": node, "containers": [
+                   {"name": "c", "resources": {
+                       "requests": {"cpu": "100m"}}}]}}
+        if affinity:
+            pod["spec"]["affinity"] = affinity
+        return pod
+
+    scenario(
+        "ipa_ns_asymmetry_existing_term_ns",
+        "AffinityTerm namespace asymmetry (scoring.go:219-227 direction "
+        "(b) + types.go Matches): the EXISTING pod P0 (ns team-a, n0/z0) "
+        "carries a preferred term w=50 selecting app=client with NO "
+        "namespaceSelector -> its term namespaces are [team-a]; the "
+        "incoming pod (ns default, app=client) matches the labelSelector "
+        "but NOT the namespace, so z0 gets NO +50.  The incoming pod's "
+        "own w=10 term (app=web, no nsSelector -> [default]) matches only "
+        "P1 (ns default, n1/z1) -> raw z0=0, z1=10; min-max normalize -> "
+        "n0=0, n1=100 -> placements [n1, n0] (pods-per-node 2; one slot is taken by the existing pod).  A "
+        "symmetric misreading (ignoring the existing term's namespace) "
+        "would score z0 +50 and place n0 first",
+        "manual-arithmetic",
+        two_zone,
+        {"metadata": {"name": "inc", "namespace": "default",
+                      "labels": {"app": "client"}},
+         "spec": {"containers": [{"name": "c", "resources": {
+             "requests": {"cpu": "100m"}}}],
+             "affinity": {"podAffinity": {
+                 "preferredDuringSchedulingIgnoredDuringExecution": [{
+                     "weight": 10, "podAffinityTerm": {
+                         "topologyKey": "topology.kubernetes.io/zone",
+                         "labelSelector": {
+                             "matchLabels": {"app": "web"}}}}]}}}},
+        {"placed_count": 2, "placements": ["n1", "n0"],
+         "fail_type": "Unschedulable",
+         "fail_message": "0/2 nodes are available: 2 Too many pods."},
+        profile_block={"profile": {"score_weights": {"InterPodAffinity": 2}},
+                       "parity": True},
+        pods=[web_pod("P0", "team-a", "n0", affinity={"podAffinity": {
+                  "preferredDuringSchedulingIgnoredDuringExecution": [{
+                      "weight": 50, "podAffinityTerm": {
+                          "topologyKey": "topology.kubernetes.io/zone",
+                          "labelSelector": {
+                              "matchLabels": {"app": "client"}}}}]}}),
+              web_pod("P1", "default", "n1")],
+        snapshot_extra={"namespaces": ns_objects})
+
+    scenario(
+        "ipa_ns_selector_cross_namespace",
+        "namespaceSelector (scoring.go:128-160 direction (a)): the "
+        "incoming pod's w=10 term selects app=web ACROSS namespaces "
+        "labeled team=a.  P0 (ns team-a/z0) matches; P1 (ns default/z1) "
+        "has the labels but its namespace carries no team=a label -> raw "
+        "z0=10, z1=0 -> n0=100, n1=0 -> placements [n0, n1].  Treating "
+        "the selector as owner-namespace-only would match P1 instead and "
+        "place [n1, n0]",
+        "manual-arithmetic",
+        two_zone,
+        {"metadata": {"name": "inc", "namespace": "default",
+                      "labels": {"app": "client"}},
+         "spec": {"containers": [{"name": "c", "resources": {
+             "requests": {"cpu": "100m"}}}],
+             "affinity": {"podAffinity": {
+                 "preferredDuringSchedulingIgnoredDuringExecution": [{
+                     "weight": 10, "podAffinityTerm": {
+                         "topologyKey": "topology.kubernetes.io/zone",
+                         "namespaceSelector": {
+                             "matchLabels": {"team": "a"}},
+                         "labelSelector": {
+                             "matchLabels": {"app": "web"}}}}]}}}},
+        {"placed_count": 2, "placements": ["n0", "n1"],
+         "fail_type": "Unschedulable",
+         "fail_message": "0/2 nodes are available: 2 Too many pods."},
+        profile_block={"profile": {"score_weights": {"InterPodAffinity": 2}},
+                       "parity": True},
+        pods=[web_pod("P0", "team-a", "n0"),
+              web_pod("P1", "default", "n1")],
+        snapshot_extra={"namespaces": ns_objects})
 
 
 if __name__ == "__main__":

@@ -266,3 +266,65 @@ def test_golden_preferred_anti_affinity_round_robin():
     res = sim.solve(pb)
     assert res.placements == [0, 1, 2, 0, 1, 2]
     assert res.fail_message == "0/3 nodes are available: 3 Too many pods."
+
+
+def test_golden_extender_preemption_victim_merge():
+    """ProcessPreemption victim-merge semantics (extender.go:343-373 +
+    preemption.go callExtenders): an extender's response keeps a candidate
+    node either with UPDATED full victim pods or with a non-list /
+    MetaVictims payload — the latter must retain the LOCALLY computed
+    victims, not drop the node.
+
+    Derivation: two 1000m nodes each hosting one 900m priority-0 victim;
+    the preemptor asks 900m at priority 10, so each node's minimal victim
+    set is its own pod.  pickOneNode criteria (preemption.go:583-653) all
+    tie (no PDBs, equal priorities, equal victim counts, no start times)
+    -> first candidate in node order, n0.
+
+    (1) An extender answering {n0: <non-list>, n1: <full local list>}
+    keeps BOTH candidates (n0 via the merge-keeps-local rule), so the
+    choice stays n0 — a merge that dropped non-list entries would flip the
+    answer to n1.
+    (2) An extender answering only {n1: <non-list>} removes n0 from the
+    candidate map entirely (intersection), so the preemptor lands on n1."""
+    def make_cluster():
+        nodes = [build_test_node(f"n{i}", 1000, 4 * 1024 ** 3, 5,
+                                 labels={"kubernetes.io/hostname": f"n{i}"})
+                 for i in range(2)]
+        pods = []
+        for i in range(2):
+            p = build_test_pod(f"low-{i}", 900, 0, node_name=f"n{i}")
+            p["spec"]["priority"] = 0
+            pods.append(p)
+        return nodes, pods
+
+    from cluster_capacity_tpu.engine.extenders import ExtenderConfig
+
+    vip = default_pod(build_test_pod("vip", 900, 0))
+    vip["spec"]["priority"] = 10
+
+    def keeps_both_meta(pod, node_to_victims):
+        # n0 keyed with a non-list payload (the MetaVictims shape after
+        # transport) -> local victims retained; n1 echoed in full
+        return {"n0": {"Pods": None}, "n1": list(node_to_victims["n1"])}
+
+    nodes, pods = make_cluster()
+    profile = SchedulerProfile.parity()
+    profile.extenders = [ExtenderConfig(preempt_callable=keeps_both_meta)]
+    cc = ClusterCapacity(vip, max_limit=1, profile=profile)
+    cc.sync_with_objects(nodes, pods)
+    res = cc.run()
+    assert res.placed_count == 1 and res.placements == [0], \
+        "merge must keep n0 with its local victims"
+
+    def only_n1_meta(pod, node_to_victims):
+        return {"n1": {"Pods": None}}
+
+    nodes, pods = make_cluster()
+    profile2 = SchedulerProfile.parity()
+    profile2.extenders = [ExtenderConfig(preempt_callable=only_n1_meta)]
+    cc2 = ClusterCapacity(vip, max_limit=1, profile=profile2)
+    cc2.sync_with_objects(nodes, pods)
+    res2 = cc2.run()
+    assert res2.placed_count == 1 and res2.placements == [1], \
+        "intersection must drop the unreturned candidate n0"

@@ -86,6 +86,21 @@ def probe() -> bool:
         return r.returncode == 0
     except subprocess.TimeoutExpired:
         return False
+    except Exception as e:
+        # _child_env imports bench.py, which a concurrent edit can briefly
+        # break — a long-running watch must survive that (round 5: the
+        # watcher died to exactly this and burned 3 h of probe coverage).
+        # Log at most once per distinct error (the watch loop's sparse
+        # miss-logging doesn't cover this print).
+        msg = f"{type(e).__name__}: {e}"
+        if msg not in _probe_errors_seen:
+            _probe_errors_seen.add(msg)
+            print(f"[capture] probe error ({msg}); treating as dead",
+                  flush=True)
+        return False
+
+
+_probe_errors_seen: set = set()
 
 
 # --------------------------------------------------------------------------
@@ -334,7 +349,13 @@ def main() -> None:
             misses = 0
             _append({"stage": "_probe", "ok": True})
             print("[capture] tunnel alive; running ladder", flush=True)
-            if ladder():
+            try:
+                done = ladder()
+            except Exception as e:       # never let one window kill the watch
+                print(f"[capture] ladder error "
+                      f"({type(e).__name__}: {e})", flush=True)
+                done = False
+            if done:
                 print("[capture] all stages captured; exiting", flush=True)
                 return
         else:
