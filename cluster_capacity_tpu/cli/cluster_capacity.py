@@ -77,6 +77,14 @@ def build_parser(prog: str = "cluster-capacity") -> argparse.ArgumentParser:
                         "every PERIOD seconds (the reference's historical "
                         "--period flag, doc/cluster-capacity.md). 0 = run "
                         "once.")
+    p.add_argument("--watch", action="store_true",
+                   help="Stream mode on top of --period (default period "
+                        "10s): keep the tensorized snapshot — and every "
+                        "memoized encode on it — across iterations and "
+                        "just re-solve, re-syncing only when the "
+                        "--snapshot file changes on disk.  Live "
+                        "--kubeconfig watches re-sync every period (no "
+                        "change signal).  One report per iteration.")
     p.add_argument("--period-iterations", dest="period_iterations", type=int,
                    default=0, help=argparse.SUPPRESS)  # test hook: stop after N
     p.add_argument("--record-golden", dest="record_golden", default="",
@@ -169,22 +177,59 @@ def run(argv: Optional[List[str]] = None, prog: str = "cluster-capacity") -> int
               "extenders", file=sys.stderr)
         return 1
 
+    # --watch snapshot cache: the tensorized ClusterSnapshot (with its
+    # per-snapshot memoized encodes) survives iterations; a change of the
+    # --snapshot file (mtime/size/inode — mtime alone misses same-tick
+    # rewrites and atomic-rename replaces) triggers a fresh sync.  Plain
+    # --period keeps its historical semantics (re-sync every iteration).
+    snap_cache: dict = {"snap": None, "raw": None, "stat": None,
+                        "options": {}}
+
+    def _load_snapshot_fresh():
+        """(snapshot, raw objects, from_objects options)."""
+        if args.snapshot.endswith(".npz"):
+            from ..utils.checkpoint import load as load_checkpoint
+            return load_checkpoint(args.snapshot), None, {}
+        from ..models.snapshot import ClusterSnapshot
+        from ..utils.trace import SPAN_SNAPSHOT, default_tracer
+        objs = load_snapshot_objects(args.snapshot)
+        # raw objects are only consumed by --record-golden; don't pin a
+        # second full copy of the cluster for ordinary (watch) runs
+        raw = {k: list(v) for k, v in objs.items()
+               if isinstance(v, list)} if args.record_golden else None
+        kwargs = {}
+        if args.node_order == "zone-round-robin":
+            kwargs["node_order"] = "zone-round-robin"
+        with default_tracer.span(SPAN_SNAPSHOT):
+            snap = ClusterSnapshot.from_objects(
+                objs.pop("nodes", []), objs.pop("pods", []),
+                exclude_nodes=exclude, **objs, **kwargs)
+        return snap, raw, kwargs
+
+    def current_snapshot():
+        """(snapshot, raw objects, options); (None, ...) for live sync."""
+        if not args.snapshot:
+            return None, None, {}
+        stat_key = None
+        try:
+            st = os.stat(args.snapshot)
+            stat_key = (st.st_mtime_ns, st.st_size, st.st_ino)
+        except OSError:
+            pass
+        if snap_cache["snap"] is None or not args.watch \
+                or stat_key != snap_cache["stat"]:
+            (snap_cache["snap"], snap_cache["raw"],
+             snap_cache["options"]) = _load_snapshot_fresh()
+            snap_cache["stat"] = stat_key
+        return snap_cache["snap"], snap_cache["raw"], snap_cache["options"]
+
     def one_run():
         if len(pods) == 1:
             cc = ClusterCapacity(pods[0], max_limit=args.max_limit,
                                  profile=profile, exclude_nodes=exclude)
-            raw_objs = None
-            if args.snapshot.endswith(".npz"):
-                from ..utils.checkpoint import load as load_checkpoint
-                cc.snapshot = load_checkpoint(args.snapshot)
-            elif args.snapshot:
-                objs = load_snapshot_objects(args.snapshot)
-                raw_objs = {k: list(v) for k, v in objs.items()
-                            if isinstance(v, list)}
-                if args.node_order == "zone-round-robin":
-                    objs["node_order"] = "zone-round-robin"
-                cc.sync_with_objects(objs.pop("nodes", []),
-                                     objs.pop("pods", []), **objs)
+            snap, raw_objs, snap_opts = current_snapshot()
+            if snap is not None:
+                cc.set_snapshot(snap, **snap_opts)
             else:
                 cc.sync_with_client(_load_live_cluster(args.kubeconfig))
             if args.save_snapshot:
@@ -203,22 +248,15 @@ def run(argv: Optional[List[str]] = None, prog: str = "cluster-capacity") -> int
 
         # multi-template run against one snapshot: independent batched
         # what-if sweep, or --interleave for shared-state queue semantics
-        from ..models.snapshot import ClusterSnapshot
         from ..parallel.sweep import sweep
         from ..utils.report import build_review
         if not args.snapshot:
             raise SystemExit("multi-podspec sweeps require --snapshot")
-        objs = load_snapshot_objects(args.snapshot)
         import time
 
         from ..utils import metrics as metrics_mod
-        from ..utils.trace import SPAN_SNAPSHOT, SPAN_SOLVE, default_tracer
-        if args.node_order == "zone-round-robin":
-            objs["node_order"] = "zone-round-robin"
-        with default_tracer.span(SPAN_SNAPSHOT):
-            snapshot = ClusterSnapshot.from_objects(
-                objs.pop("nodes", []), objs.pop("pods", []),
-                exclude_nodes=exclude, **objs)
+        from ..utils.trace import SPAN_SOLVE, default_tracer
+        snapshot, _raw, _opts = current_snapshot()
         t0 = time.perf_counter()
         with default_tracer.span(SPAN_SOLVE), default_tracer.profile():
             if args.interleave:
@@ -240,6 +278,8 @@ def run(argv: Optional[List[str]] = None, prog: str = "cluster-capacity") -> int
         return build_review(pods, results)
 
     import time
+    if args.watch and args.period <= 0:
+        args.period = 10.0
     runs = 0
     while True:
         review = one_run()

@@ -46,6 +46,14 @@ class ClusterCapacity:
             nodes, pods, exclude_nodes=self.exclude_nodes,
             **self._snapshot_options, **extra)
 
+    def set_snapshot(self, snapshot: "ClusterSnapshot", **options) -> None:
+        """Install an already-built snapshot (checkpoint load, --watch
+        reuse).  `options` are the from_objects options a preemption
+        full-rebuild must preserve (node_order / sort_nodes / use_native)
+        — assigning .snapshot directly would silently drop them."""
+        self._snapshot_options = dict(options)
+        self.snapshot = snapshot
+
     # live-sync resource kinds beyond nodes/pods: duck-typed method name →
     # sync_with_objects keyword (the reference copies the same ten kinds,
     # simulator.go:176-295; storage/policy/scheduling APIs may live on the

@@ -127,6 +127,70 @@ def test_period_continuous_mode(tmp_path, capsys, monkeypatch):
     assert out.count("can schedule 4 instance(s)") == 1
 
 
+def test_watch_stream_reuses_snapshot(tmp_path, capsys, monkeypatch):
+    """--watch keeps the tensorized snapshot across iterations (ONE load
+    while the file is unchanged) and re-syncs when the file's mtime
+    changes — the checkpoint-reuse stream mode on top of --period."""
+    import json
+    import os as os_mod
+    import time as time_mod
+    from cluster_capacity_tpu.cli import cluster_capacity as mod
+    from cluster_capacity_tpu.cli.cluster_capacity import run
+
+    def snap_with_cpu(cpu):
+        return {"nodes": [{"metadata": {"name": "n0"}, "spec": {},
+                           "status": {"allocatable": {"cpu": cpu,
+                                                      "memory": "4Gi",
+                                                      "pods": "10"}}}]}
+    sp = tmp_path / "snap.json"
+    sp.write_text(json.dumps(snap_with_cpu("1")))
+    podf = tmp_path / "pod.yaml"
+    podf.write_text("metadata:\n  name: p\nspec:\n  containers:\n"
+                    "  - name: c\n    resources:\n      requests:\n"
+                    "        cpu: 500m\n")
+
+    loads = []
+    real_load = mod.load_snapshot_objects
+
+    def counting_load(path):
+        loads.append(path)
+        return real_load(path)
+
+    monkeypatch.setattr(mod, "load_snapshot_objects", counting_load)
+
+    # phase 1: three unchanged iterations -> exactly one load
+    real_sleep = time_mod.sleep
+    monkeypatch.setattr(time_mod, "sleep", lambda s: real_sleep(0))
+    rc = run(["--podspec", str(podf), "--snapshot", str(sp), "--verbose",
+              "--watch", "--period", "0.01", "--period-iterations", "3"])
+    assert rc == 0
+    assert len(loads) == 1, "unchanged file must be loaded once"
+    out = capsys.readouterr().out
+    assert out.count("can schedule 2 instance(s)") == 3
+
+    # phase 2: an mtime change mid-stream triggers exactly one re-sync
+    loads.clear()
+    iterations = []
+
+    def sleep_and_grow(seconds):
+        if not iterations:
+            sp.write_text(json.dumps(snap_with_cpu("2")))
+            # ensure a strictly newer mtime even on coarse filesystems
+            st = os_mod.stat(sp)
+            os_mod.utime(sp, ns=(st.st_atime_ns, st.st_mtime_ns + 10 ** 6))
+        iterations.append(1)
+        real_sleep(0)
+
+    monkeypatch.setattr(time_mod, "sleep", sleep_and_grow)
+    rc = run(["--podspec", str(podf), "--snapshot", str(sp), "--verbose",
+              "--watch", "--period", "0.01", "--period-iterations", "3"])
+    assert rc == 0
+    assert len(loads) == 2, "one initial load + one mtime-triggered re-sync"
+    out = capsys.readouterr().out
+    assert out.count("can schedule 2 instance(s)") == 1
+    assert out.count("can schedule 4 instance(s)") == 2
+
+
 def test_interleave_flag(tmp_path, capsys):
     import json
     from cluster_capacity_tpu.cli.cluster_capacity import run
