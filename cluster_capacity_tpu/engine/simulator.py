@@ -383,7 +383,8 @@ def _row_add(arr: "jax.Array", idx: "jax.Array", delta: "jax.Array") -> "jax.Arr
     return jax.lax.dynamic_update_slice_in_dim(arr, row + delta, idx, axis=0)
 
 
-def _feasibility(cfg: StaticConfig, consts, carry: Carry, eanti_dyn=None):
+def _feasibility(cfg: StaticConfig, consts, carry: Carry, eanti_dyn=None,
+                 ports_blocked=None):
     """All filter masks for the current state.  Returns (feasible, parts dict
     for diagnosis).
 
@@ -392,7 +393,14 @@ def _feasibility(cfg: StaticConfig, consts, carry: Carry, eanti_dyn=None):
     my anti terms' and 'pods whose anti terms match me' coincide and both
     read carry.anti_cnt; the tensor interleave engine carries them
     separately (another template's clone can have anti terms this template's
-    own selector never matches)."""
+    own selector never matches).
+
+    ports_blocked (bool[N]) overrides the dynamic host-port conflict rule:
+    the single-template rule is 'any own clone on the node' (carry.placed),
+    but the interleave engine must also block on OTHER templates' clones
+    with overlapping ports — it computes the mask from its cross-template
+    port-conflict matrix and passes it here so the diagnosis attribution
+    slot (before fit, mirroring the filter chain order) stays shared."""
     feasible = consts["static_mask"]
     parts = {}
 
@@ -409,8 +417,11 @@ def _feasibility(cfg: StaticConfig, consts, carry: Carry, eanti_dyn=None):
         parts["fit"] = fitv
         feasible = feasible & fitv.mask
 
-    if cfg.clone_has_ports:
-        ports_ok = ~(carry.placed > 0)
+    if cfg.clone_has_ports or ports_blocked is not None:
+        if ports_blocked is not None:
+            ports_ok = ~ports_blocked
+        else:
+            ports_ok = ~(carry.placed > 0)
         parts["ports_dyn"] = ports_ok
         feasible = feasible & ports_ok
 
@@ -870,12 +881,14 @@ def solve(pb: enc.EncodedProblem, max_limit: int = 0,
 
 
 def diagnose(pb: enc.EncodedProblem, cfg: StaticConfig, consts,
-             carry: Carry, eanti_dyn=None) -> Dict[str, int]:
+             carry: Carry, eanti_dyn=None,
+             ports_blocked=None) -> Dict[str, int]:
     """Per-reason node counts at the stopping state — the tensor equivalent of
     the FitError reasons histogram (types.go:787-828).  Each infeasible node
     contributes the reason(s) of its first failing plugin in filter order; the
     fit plugin contributes every insufficient resource (fit.go:564-660)."""
-    feasible, parts = _feasibility(cfg, consts, carry, eanti_dyn=eanti_dyn)
+    feasible, parts = _feasibility(cfg, consts, carry, eanti_dyn=eanti_dyn,
+                                   ports_blocked=ports_blocked)
     n = pb.snapshot.num_nodes
     static_code = np.asarray(pb.static_code)
 

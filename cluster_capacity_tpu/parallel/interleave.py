@@ -23,9 +23,14 @@ differential oracle for this engine — tests/test_interleave_tensor.py):
   fire at chunk boundaries in placement order;
 - preemption and priority tiers run natively (tier-ranked pops on device,
   victim selection as a rare host event between chunks);
-- templates must share one jit specialization (sweep._group_key) and the
-  snapshot resource vocabulary; clone self-conflict gates (host ports,
-  inline disks, RWOP, shared DRA claims) stay on the object path.
+- host-port templates run natively (r5): a static [T, T] cross-template
+  port-conflict matrix times the carried per-template clone counts gives
+  each pop's blocked-node mask, sharing the single-template engine's
+  diagnosis slot via _feasibility(ports_blocked=...);
+- templates must share one jit specialization (sweep._group_key; the
+  ports flag normalizes out) and the snapshot resource vocabulary; the
+  remaining clone self-conflict gates (inline disks, RWOP, shared DRA
+  claims) stay on the object path.
 
 Queue semantics mirrored exactly (differentially tested):
 - round-robin pops among active templates in arrival order (equal
@@ -70,7 +75,8 @@ class XCarry(NamedTuple):
 
     requested: "jax.Array"        # f[N, R]   shared
     nonzero: "jax.Array"          # f[N, 2]   shared
-    placed: "jax.Array"           # i32[N]    shared (all clones)
+    tpl_placed: "jax.Array"       # i32[T, N] per-template clone counts
+                                  # (shared total = tpl_placed.sum(0))
     sh_cnt: "jax.Array"           # f[T, Ch, N]
     ss_cnt: "jax.Array"           # f[T, Cs, N]
     ssh_cnt: "jax.Array"          # f[T, Cs, N] hostname-row clone counts
@@ -196,6 +202,27 @@ def _ipa_xinc(pbs) -> Dict[str, np.ndarray]:
             "pref_xinc": pref}
 
 
+def _port_conflict_matrix(pbs) -> np.ndarray:
+    """conflict[t, u]: does a clone of template u on a node block template
+    t's clone there via host ports (NodePorts semantics: same protocol +
+    port, hostIP wildcard 0.0.0.0 matches everything)?  Symmetric; the
+    diagonal is True for any template with host ports (clones of one
+    template always clash with themselves).  The object path reaches the
+    same verdicts through oracle._filter_node over the shared pod roster."""
+    ports = [ps.pod_host_ports(pb.pod) for pb in pbs]
+    t_n = len(pbs)
+    out = np.zeros((t_n, t_n))
+    for a in range(t_n):
+        for b in range(a, t_n):
+            hit = any(
+                ap == bp and aproto == bproto and
+                (aip == "0.0.0.0" or bip == "0.0.0.0" or aip == bip)
+                for (aproto, aip, ap) in ports[a]
+                for (bproto, bip, bp) in ports[b])
+            out[a, b] = out[b, a] = float(hit)
+    return out
+
+
 def union_topology_keys(templates: Sequence[dict]) -> List[str]:
     """Every topologyKey used by any template's affinity terms — the extra
     group rows each template's encoding needs so cross contributions from
@@ -280,8 +307,11 @@ def eligible(snapshot: ClusterSnapshot, templates: Sequence[dict],
         return None                     # nothing to tensor-solve; trivial
     rn = solvable[0].resource_names
     for pb in solvable:
-        if not sweep_mod._batchable(pb) or pb.clone_has_host_ports:
-            return "clone self-conflict gates (ports/volumes/DRA)"
+        # host-port templates run natively (r5: cross-template conflict
+        # matrix × per-template placed counts); the remaining clone
+        # self-conflict gates stay on the object path
+        if sweep_mod._clone_self_conflict(pb):
+            return "clone self-conflict gates (volumes/DRA)"
         if pb.resource_names != rn:
             return "templates disagree on the resource vocabulary"
     # _group_key keeps the lonely-pod escape statics in the key so batched
@@ -296,8 +326,11 @@ def eligible(snapshot: ClusterSnapshot, templates: Sequence[dict],
         if cfg.ipa_num_aff:
             aff_flags.add((cfg.ipa_escape_allowed, cfg.ipa_static_empty))
         k = sweep_mod._group_key(pb, cfg)
+        # clone_has_ports normalizes out: the ports gate is data-driven
+        # here (port-conflict matrix × tpl_placed), not a cfg branch
         keys.add((k[0]._replace(ipa_escape_allowed=False,
-                                ipa_static_empty=False),) + tuple(k[1:]))
+                                ipa_static_empty=False,
+                                clone_has_ports=False),) + tuple(k[1:]))
     if len(keys) > 1:
         return "templates need different jit specializations"
     if len(aff_flags) > 1:
@@ -354,15 +387,21 @@ def _xstep(cfg: sim.StaticConfig, sconsts, xconsts, xc: XCarry):
     c_t["ss_self"] = jnp.zeros_like(c_t["ss_self"])
 
     view = sim.Carry(
-        requested=xc.requested, nonzero=xc.nonzero, placed=xc.placed,
+        requested=xc.requested, nonzero=xc.nonzero,
+        placed=_idx(xc.tpl_placed, t),   # OWN clones (single-template view)
         sh_cnt=_idx(xc.sh_cnt, t), ss_cnt=_idx(xc.ss_cnt, t),
         aff_cnt=_idx(xc.aff_cnt, t), anti_cnt=_idx(xc.anti_cnt, t),
         pref_cnt=_idx(xc.pref_cnt, t), aff_total=xc.aff_total[t],
         placed_count=xc.k[t], stopped=~live, next_start=xc.next_start[t],
         rng=jax.random.PRNGKey(0))
 
+    # host-port conflicts from ANY template's clones (incl. own): the
+    # object path reaches the same verdicts through the shared pod roster
+    conflict_row = _idx(xconsts["port_conflict"], t)       # [T]
+    ports_blocked = (conflict_row @ (xc.tpl_placed > 0).astype(dt)) > 0.5
     feasible, parts = sim._feasibility(cfg, c_t, view,
-                                       eanti_dyn=_idx(xc.eanti_cnt, t))
+                                       eanti_dyn=_idx(xc.eanti_cnt, t),
+                                       ports_blocked=ports_blocked)
     any_feasible = jnp.any(feasible)
     scorable, new_ns = sim._sample_scorable(cfg, feasible, xc.next_start[t])
     # extender Filter applies to the SAMPLED window, after the in-tree
@@ -402,7 +441,10 @@ def _xstep(cfg: sim.StaticConfig, sconsts, xconsts, xc: XCarry):
     else:
         ipa_fail = jnp.zeros(n_nodes, dtype=bool)
     base_ok = c_t["static_mask"] & fit_ok & c_t["volume_mask"]
+    # dynamic port conflicts attribute BEFORE fit (filter-chain order), so
+    # any statically-clean blocked node carries the curable ports reason
     curable_node = _idx(xconsts["static_ports_fail"], t) | \
+        (c_t["static_mask"] & ports_blocked) | \
         (base_ok & (sm | ~s_ok | ipa_fail))
     curable_now = jnp.any(curable_node)
     # A template that could preempt (some pod in the system sits strictly
@@ -419,7 +461,10 @@ def _xstep(cfg: sim.StaticConfig, sconsts, xconsts, xc: XCarry):
                              (gate * c_t["req_vec"])[None, :])
     nonzero = sim._row_add(xc.nonzero, chosen,
                            (gate * c_t["req_nonzero"])[None, :])
-    placed = sim._row_add(xc.placed, chosen, do.astype(jnp.int32).reshape(1))
+    chosen_onehot = jnp.arange(xc.tpl_placed.shape[1],
+                               dtype=jnp.int32) == chosen
+    tpl_placed = xc.tpl_placed + (onehot_t[:, None] & chosen_onehot[None, :]
+                                  & do).astype(jnp.int32)
 
     sh_cnt, ss_cnt, ssh_cnt = xc.sh_cnt, xc.ss_cnt, xc.ssh_cnt
     if cfg.spread_hard_n > 0:
@@ -438,7 +483,7 @@ def _xstep(cfg: sim.StaticConfig, sconsts, xconsts, xc: XCarry):
         ss_cnt = xc.ss_cnt + hit.astype(dt) * inc[:, :, None]
         # hostname rows: matching-clones-on-the-node counts, ungated by the
         # inclusion policy (hostname_cnt parity with simulator._scores)
-        n = xc.placed.shape[0]
+        n = xc.tpl_placed.shape[1]
         node_onehot = (jnp.arange(n, dtype=jnp.int32) == chosen).astype(dt)
         inc_h = xrow * sconsts["ss_host"].astype(dt) * gate    # [T, Cs]
         ssh_cnt = xc.ssh_cnt + inc_h[:, :, None] * node_onehot[None, None, :]
@@ -477,7 +522,8 @@ def _xstep(cfg: sim.StaticConfig, sconsts, xconsts, xc: XCarry):
                            xc.next_start)
 
     out = XCarry(
-        requested=requested, nonzero=nonzero, placed=placed,
+        requested=requested, nonzero=nonzero,
+        tpl_placed=tpl_placed,
         sh_cnt=sh_cnt, ss_cnt=ss_cnt, ssh_cnt=ssh_cnt,
         aff_cnt=aff_cnt, anti_cnt=anti_cnt, eanti_cnt=eanti_cnt,
         pref_cnt=pref_cnt, aff_total=aff_total,
@@ -600,6 +646,10 @@ def solve_interleaved_tensor(snapshot: ClusterSnapshot,
                                       ipa_extra_keys=extra_keys)
                    for t in solve_templates]
         pbs, cfg, dnh = sweep_mod._pad_group(pbs_new)
+        # the host-port gate rides the conflict matrix + tpl_placed, not
+        # the cfg branch (whose single-template placed>0 rule would read
+        # the WRONG tensor here)
+        cfg = cfg._replace(clone_has_ports=False)
         consts_list = [sim.build_consts(pb, ss_dnh_min=dnh) for pb in pbs]
         sconsts = {k: jnp.stack([c[k] for c in consts_list])
                    for k in consts_list[0]}
@@ -618,6 +668,9 @@ def solve_interleaved_tensor(snapshot: ClusterSnapshot,
                 maybe if preempt_on else np.zeros(t_n, dtype=bool)),
             "ext_mask": jnp.asarray(ext_mask_np),
             "ext_bonus": f(ext_bonus_np),
+            "port_conflict": f(_port_conflict_matrix(pbs)
+                               if profile.filter_enabled("NodePorts")
+                               else np.zeros((t_n, t_n))),
             **{k: f(v) for k, v in _ipa_xinc(pbs).items()},
         }
         return pbs, cfg, dnh, consts_list, sconsts, xconsts, dt
@@ -632,7 +685,11 @@ def solve_interleaved_tensor(snapshot: ClusterSnapshot,
         return XCarry(
             requested=f(pbs[0].init_requested),
             nonzero=f(pbs[0].init_nonzero),
-            placed=jnp.zeros(n, dtype=jnp.int32),
+            # per-template clone counts start at zero even after an
+            # eviction rebuild: surviving clones are baked into the
+            # re-encoded snapshot (static port masks included), exactly
+            # like the carried spread/affinity counts
+            tpl_placed=jnp.zeros((t_n, n), dtype=jnp.int32),
             sh_cnt=sconsts["sh_cnt_init"],
             ss_cnt=sconsts["ss_cnt_init"],
             ssh_cnt=jnp.zeros((t_n, cs, n), dtype=dt),
@@ -668,22 +725,30 @@ def solve_interleaved_tensor(snapshot: ClusterSnapshot,
 
     def view_of(ti: int):
         return sim.Carry(
-            requested=xc.requested, nonzero=xc.nonzero, placed=xc.placed,
+            requested=xc.requested, nonzero=xc.nonzero,
+            placed=xc.tpl_placed[ti],
             sh_cnt=xc.sh_cnt[ti], ss_cnt=xc.ss_cnt[ti],
             aff_cnt=xc.aff_cnt[ti], anti_cnt=xc.anti_cnt[ti],
             pref_cnt=xc.pref_cnt[ti], aff_total=xc.aff_total[ti],
             placed_count=xc.k[ti], stopped=jnp.asarray(True),
             next_start=xc.next_start[ti], rng=jax.random.PRNGKey(0))
 
+    def ports_blocked_of(ti: int):
+        conflict = np.asarray(xconsts["port_conflict"])[ti]       # [T]
+        live = np.asarray(xc.tpl_placed) > 0                      # [T, N]
+        return jnp.asarray(conflict @ live.astype(np.float64) > 0.5)
+
     def park_result(ti: int):
         counts = sim.diagnose(pbs[ti], cfg, consts_list[ti], view_of(ti),
-                              eanti_dyn=xc.eanti_cnt[ti])
+                              eanti_dyn=xc.eanti_cnt[ti],
+                              ports_blocked=ports_blocked_of(ti))
         if extenders:
             # nodes the in-tree filters accept can only have been lost to
             # the extender Filter chain — the object path attributes the
             # whole in-tree-feasible set to that bucket
             feas, _ = sim._feasibility(cfg, consts_list[ti], view_of(ti),
-                                       eanti_dyn=xc.eanti_cnt[ti])
+                                       eanti_dyn=xc.eanti_cnt[ti],
+                                       ports_blocked=ports_blocked_of(ti))
             n_feas = int(np.asarray(feas).sum())
             if n_feas:
                 counts = dict(counts)

@@ -31,14 +31,23 @@ from ..utils.config import SchedulerProfile
 from . import mesh as mesh_lib
 
 
+def _clone_self_conflict(pb: enc.EncodedProblem) -> bool:
+    """Clone self-conflict gates the tensor engines cannot express as
+    carried per-template state (host ports CAN — the interleave engine's
+    port-conflict matrix — so they are a separate flag).  Single source for
+    _batchable and interleave.eligible: a new gate added here falls both
+    engines back together."""
+    return (pb.volume_self_conflict or pb.rwop_self_conflict
+            or pb.dra_shared_colocate)
+
+
 def _batchable(pb: enc.EncodedProblem) -> bool:
     """Templates whose constraints can ride a vmapped group solve.  Spread
     and inter-pod-affinity templates batch too (their per-node count tensors
     pad to a group-wide constraint/group count with inert rows); only the
     rare clone self-conflict gates and pod-level rejections stay sequential."""
     return (not pb.clone_has_host_ports and
-            pb.pod_level_reason is None and not pb.volume_self_conflict and
-            not pb.rwop_self_conflict and not pb.dra_shared_colocate)
+            pb.pod_level_reason is None and not _clone_self_conflict(pb))
 
 
 def _group_key(pb: enc.EncodedProblem, cfg) -> tuple:
