@@ -54,7 +54,7 @@ WATCH_PERIOD = int(os.environ.get("CAPTURE_WATCH_PERIOD", "30"))
 WATCH_MAX_S = int(os.environ.get("CAPTURE_WATCH_MAX_S", "28800"))
 # Generation tag: bump when the kernels change materially so the ladder
 # re-measures instead of trusting stale captures.
-GEN = os.environ.get("CAPTURE_GEN", "r4")
+GEN = os.environ.get("CAPTURE_GEN", "r5")
 
 
 def _child_env(**extra) -> dict:
