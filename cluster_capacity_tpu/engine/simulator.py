@@ -324,6 +324,14 @@ def build_consts(pb: enc.EncodedProblem,
         "ipa_anti_scnt": f(_expand_counts(ipa.anti_init, ipa.node_domain)),
         "ipa_eanti_static": jnp.asarray(ipa.existing_anti_static),
         "ipa_static_pref": f(pb.ipa.static_pref_score),
+        # per-template self-conflict gate scalars: in a single-template
+        # solve each equals its StaticConfig flag; in a stacked group the
+        # cfg flag goes on when ANY template needs the gate and these
+        # scalars keep it inert for the others (the interleave engine's
+        # per-template Carry views rely on this)
+        "vol_self_gate": f(1.0 if pb.volume_self_conflict else 0.0),
+        "rwop_gate": f(1.0 if pb.rwop_self_conflict else 0.0),
+        "dra_colo_gate": f(1.0 if pb.dra_shared_colocate else 0.0),
     }
 
 
@@ -428,12 +436,15 @@ def _feasibility(cfg: StaticConfig, consts, carry: Carry, eanti_dyn=None,
     if cfg.volume_filter_on:
         feasible = feasible & consts["volume_mask"]
     if cfg.volume_self_conflict:
-        feasible = feasible & ~(carry.placed > 0)
+        feasible = feasible & ~((carry.placed > 0)
+                                & (consts["vol_self_gate"] > 0))
     if cfg.rwop_self_conflict:
-        feasible = feasible & (carry.placed_count == 0)
+        feasible = feasible & ((carry.placed_count == 0)
+                               | (consts["rwop_gate"] == 0))
     if cfg.dra_shared_colocate:
         # shared ResourceClaim: all users share one allocation → colocate
-        feasible = feasible & ((carry.placed > 0) | (carry.placed_count == 0))
+        feasible = feasible & ((carry.placed > 0) | (carry.placed_count == 0)
+                               | (consts["dra_colo_gate"] == 0))
 
     if cfg.spread_hard_n > 0:
         sp_ok, sp_missing = spread_ops.hard_filter(
@@ -956,17 +967,22 @@ def diagnose(pb: enc.EncodedProblem, cfg: StaticConfig, consts,
         add(pb.volume_reasons[i] or "volume conflict")
     remaining &= ~take
 
-    if cfg.volume_self_conflict:
+    if cfg.volume_self_conflict \
+            and float(np.asarray(consts["vol_self_gate"])) > 0:
         placed_np = np.asarray(carry.placed)
         take = remaining & (placed_np > 0)
         from ..ops.volumes import REASON_DISK_CONFLICT
         add(REASON_DISK_CONFLICT, int(take.sum()))
         remaining &= ~take
-    if cfg.rwop_self_conflict and int(np.asarray(carry.placed_count)) > 0:
+    if cfg.rwop_self_conflict \
+            and float(np.asarray(consts["rwop_gate"])) > 0 \
+            and int(np.asarray(carry.placed_count)) > 0:
         from ..ops.volumes import REASON_RWOP_CONFLICT
         add(REASON_RWOP_CONFLICT, int(remaining.sum()))
         remaining &= False
-    if cfg.dra_shared_colocate and int(np.asarray(carry.placed_count)) > 0:
+    if cfg.dra_shared_colocate \
+            and float(np.asarray(consts["dra_colo_gate"])) > 0 \
+            and int(np.asarray(carry.placed_count)) > 0:
         from ..ops.dynamic_resources import REASON_CANNOT_ALLOCATE
         placed_np = np.asarray(carry.placed)
         take = remaining & ~(placed_np > 0)

@@ -307,11 +307,22 @@ def eligible(snapshot: ClusterSnapshot, templates: Sequence[dict],
         return None                     # nothing to tensor-solve; trivial
     rn = solvable[0].resource_names
     for pb in solvable:
-        # host-port templates run natively (r5: cross-template conflict
-        # matrix × per-template placed counts); the remaining clone
-        # self-conflict gates stay on the object path
-        if sweep_mod._clone_self_conflict(pb):
-            return "clone self-conflict gates (volumes/DRA)"
+        # host ports, inline-disk, and RWOP self-conflicts run natively
+        # (r5: conflict matrix / per-template gate scalars × per-template
+        # Carry views); anything else — today shared-DRA colocation, whose
+        # cross-template claim accounting neither engine models — falls
+        # back to the object path
+        gates = sweep_mod._self_conflict_gates(pb)
+        if gates - {"disk", "rwop"}:
+            return "clone self-conflict gates (shared DRA)"
+        if "rwop" in gates and "DefaultPreemption" in profile.post_filters \
+                and _preempt_maybe(snapshot, templates).any():
+            # the RWOP gate rides the bind-ever count (xc.k), which an
+            # eviction rebuild preserves — but an EVICTED RWOP clone frees
+            # the claim (the object path's live_clones goes back to 0), so
+            # preemption-capable studies keep the object path's live
+            # accounting
+            return "RWOP with possible preemption (live-clone accounting)"
         if pb.resource_names != rn:
             return "templates disagree on the resource vocabulary"
     # _group_key keeps the lonely-pod escape statics in the key so batched
@@ -326,11 +337,14 @@ def eligible(snapshot: ClusterSnapshot, templates: Sequence[dict],
         if cfg.ipa_num_aff:
             aff_flags.add((cfg.ipa_escape_allowed, cfg.ipa_static_empty))
         k = sweep_mod._group_key(pb, cfg)
-        # clone_has_ports normalizes out: the ports gate is data-driven
-        # here (port-conflict matrix × tpl_placed), not a cfg branch
+        # self-conflict flags normalize out: ports ride the conflict
+        # matrix, disk/RWOP ride per-template gate scalars — none of them
+        # needs its own jit specialization here
         keys.add((k[0]._replace(ipa_escape_allowed=False,
                                 ipa_static_empty=False,
-                                clone_has_ports=False),) + tuple(k[1:]))
+                                clone_has_ports=False,
+                                volume_self_conflict=False,
+                                rwop_self_conflict=False),) + tuple(k[1:]))
     if len(keys) > 1:
         return "templates need different jit specializations"
     if len(aff_flags) > 1:
@@ -648,8 +662,13 @@ def solve_interleaved_tensor(snapshot: ClusterSnapshot,
         pbs, cfg, dnh = sweep_mod._pad_group(pbs_new)
         # the host-port gate rides the conflict matrix + tpl_placed, not
         # the cfg branch (whose single-template placed>0 rule would read
-        # the WRONG tensor here)
-        cfg = cfg._replace(clone_has_ports=False)
+        # the WRONG tensor here); disk/RWOP branches switch on when ANY
+        # template needs them — the per-template gate scalars in consts
+        # keep them inert for the rest
+        cfg = cfg._replace(
+            clone_has_ports=False,
+            volume_self_conflict=any(pb.volume_self_conflict for pb in pbs),
+            rwop_self_conflict=any(pb.rwop_self_conflict for pb in pbs))
         consts_list = [sim.build_consts(pb, ss_dnh_min=dnh) for pb in pbs]
         sconsts = {k: jnp.stack([c[k] for c in consts_list])
                    for k in consts_list[0]}

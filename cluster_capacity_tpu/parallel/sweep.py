@@ -31,14 +31,24 @@ from ..utils.config import SchedulerProfile
 from . import mesh as mesh_lib
 
 
+def _self_conflict_gates(pb: enc.EncodedProblem) -> set:
+    """Named clone self-conflict gates on a template.  Single source for
+    _batchable and interleave.eligible: the interleave engine subtracts the
+    gates it runs natively ('disk', 'rwop' — per-template consts scalars ×
+    per-template Carry views), so a NEW gate added here falls both engines
+    back together until someone deliberately tensorizes it."""
+    out = set()
+    if pb.volume_self_conflict:
+        out.add("disk")
+    if pb.rwop_self_conflict:
+        out.add("rwop")
+    if pb.dra_shared_colocate:
+        out.add("dra")
+    return out
+
+
 def _clone_self_conflict(pb: enc.EncodedProblem) -> bool:
-    """Clone self-conflict gates the tensor engines cannot express as
-    carried per-template state (host ports CAN — the interleave engine's
-    port-conflict matrix — so they are a separate flag).  Single source for
-    _batchable and interleave.eligible: a new gate added here falls both
-    engines back together."""
-    return (pb.volume_self_conflict or pb.rwop_self_conflict
-            or pb.dra_shared_colocate)
+    return bool(_self_conflict_gates(pb))
 
 
 def _batchable(pb: enc.EncodedProblem) -> bool:

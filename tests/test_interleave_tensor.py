@@ -173,7 +173,7 @@ def test_fuzz_mixed_families():
         ts = []
         for k in range(int(rng.choice([2, 4]))):
             kind = rng.choice(["plain", "spread", "soft", "anti",
-                               "port", "pref"])
+                               "port", "disk", "pref"])
             cpu = int(rng.choice([300, 500, 800]))
             if kind == "plain":
                 ts.append(_template(f"t{k}", cpu))
@@ -197,6 +197,11 @@ def test_fuzz_mixed_families():
                 t = _template(f"t{k}", cpu)
                 t["spec"]["containers"][0]["ports"] = [
                     {"hostPort": int(rng.choice([8080, 9090]))}]
+                ts.append(t)
+            elif kind == "disk":
+                t = _template(f"t{k}", cpu)
+                t["spec"]["volumes"] = [{"name": "v", "gcePersistentDisk": {
+                    "pdName": f"pd-{int(rng.choice([1, 2]))}"}}]
                 ts.append(t)
             else:
                 ts.append(_template(
@@ -251,16 +256,25 @@ def test_fallback_reasons():
     # extenders no longer fall back (r5, VERDICT r4 #4): one static host
     # round per template — covered differentially below
 
-    # host ports run natively as of r5 (cross-template conflict matrix) —
-    # covered differentially below; inline-disk self conflicts still fall
-    # back to the object path
-    disk = _template("d", 300)
-    disk["spec"]["volumes"] = [
-        {"name": "v", "gcePersistentDisk": {"pdName": "pd-1"}}]
-    assert il.solve_interleaved_tensor(snap, [disk], prof) is None
+    # host ports / inline disks / RWOP run natively as of r5 — covered
+    # differentially below; shared-DRA colocation still falls back
+    slices = [{"metadata": {"name": "s0"},
+               "spec": {"nodeName": "n000", "driver": "gpu.example.com",
+                        "devices": [{"name": "d0",
+                                     "deviceClassName": "gpu.example.com"}]}}]
+    claim = {"metadata": {"name": "shared", "namespace": "default"},
+             "spec": {"devices": {"requests": [
+                 {"name": "r0", "deviceClassName": "gpu.example.com",
+                  "count": 1}]}}}
+    snap_dra = ClusterSnapshot.from_objects(
+        _nodes(6), resource_slices=slices, resource_claims=[claim])
+    shared = _template("sh", 300)
+    shared["spec"]["resourceClaims"] = [
+        {"name": "gpu", "resourceClaimName": "shared"}]
+    assert il.solve_interleaved_tensor(snap_dra, [shared], prof) is None
 
     # the auto front door still answers (object fallback)
-    res = il.sweep_interleaved_auto(snap, [disk], prof, max_total=3)
+    res = il.sweep_interleaved_auto(snap_dra, [shared], prof, max_total=3)
     assert res[0].placed_count == 3
 
 
@@ -659,3 +673,121 @@ def test_host_ports_with_preemption_rebuild():
     # eviction — 2 clones means the preemption+rebuild actually ran
     assert ref[0].placed_count == 2
     assert sorted(ref[0].placements) == [0, 1]
+
+
+# --------------------------------------------------------------------------
+# inline-disk and RWOP self-conflicts on the tensor engine (r5)
+# --------------------------------------------------------------------------
+
+def test_inline_disk_self_conflict_native():
+    """An inline GCE-PD template places at most one clone per node (disk
+    self-conflict) while a plain template fills the rest — both engines
+    agree on placements and the disk FitError."""
+    snap = ClusterSnapshot.from_objects(_nodes(4))
+    disk = _template("d", 300)
+    disk["spec"]["volumes"] = [
+        {"name": "v", "gcePersistentDisk": {"pdName": "pd-1"}}]
+    plain = _template("p", 500)
+    prof = SchedulerProfile.parity()
+    ref = sweep_interleaved(snap, [disk, plain], prof)
+    got = il.solve_interleaved_tensor(snap, [disk, plain], prof)
+    _assert_same(ref, got, "disk-self")
+    assert got is not None                    # ran natively, no fallback
+    assert ref[0].placed_count == 4           # one per node
+    assert sorted(ref[0].placements) == [0, 1, 2, 3]
+    assert "no available disk" in ref[0].fail_message
+
+
+def test_rwop_single_clone_native():
+    """A ReadWriteOncePod-claim template binds exactly ONE clone cluster-
+    wide; its park carries the RWOP reason; the plain template interleaves
+    unaffected."""
+    pvcs = [{"metadata": {"name": "exclusive", "namespace": "default"},
+             "spec": {"accessModes": ["ReadWriteOncePod"],
+                      "volumeName": "vol1"}}]
+    pvs = [{"metadata": {"name": "vol1"},
+            "spec": {"accessModes": ["ReadWriteOncePod"]}}]
+    snap = ClusterSnapshot.from_objects(_nodes(3), pvcs=pvcs, pvs=pvs)
+    rwop = _template("r", 300)
+    rwop["spec"]["volumes"] = [
+        {"name": "v", "persistentVolumeClaim": {"claimName": "exclusive"}}]
+    plain = _template("p", 500)
+    prof = SchedulerProfile.parity()
+    ref = sweep_interleaved(snap, [rwop, plain], prof)
+    got = il.solve_interleaved_tensor(snap, [rwop, plain], prof)
+    _assert_same(ref, got, "rwop")
+    assert got is not None
+    assert ref[0].placed_count == 1
+    assert "ReadWriteOncePod" in ref[0].fail_message
+
+
+def test_disk_rwop_port_mix_with_spread():
+    """All three native gates plus a spread template racing through one
+    cluster — full differential."""
+    snap = ClusterSnapshot.from_objects(_nodes(6))
+    disk = _template("d", 250)
+    disk["spec"]["volumes"] = [
+        {"name": "v", "gcePersistentDisk": {"pdName": "pd-x"}}]
+    port = _port_template("q", 250, 8080)
+    spread = _template("s", 250, spread=(1, "topology.kubernetes.io/zone",
+                                         {"app": "s"}))
+    plain = _template("p", 400)
+    prof = SchedulerProfile.parity()
+    ts = [disk, port, spread, plain]
+    ref = sweep_interleaved(snap, ts, prof)
+    got = il.solve_interleaved_tensor(snap, ts, prof)
+    _assert_same(ref, got, "mix-gates")
+    assert got is not None
+
+
+def test_disk_self_conflict_through_preemption_rebuild():
+    """A disk template's clone survives an eviction rebuild: its node must
+    stay blocked (the clone's inline disk re-bakes into the static mask)
+    while the eviction frees capacity elsewhere — differential through the
+    whole preempt + rebuild sequence."""
+    nodes = _nodes(2, pods=2)
+    squatter = {"metadata": {"name": "squat", "namespace": "default"},
+                "spec": {"nodeName": "n000", "priority": 0,
+                         "containers": [{"name": "c", "resources": {
+                             "requests": {"cpu": "1500m"}}}]}}
+    snap = ClusterSnapshot.from_objects(
+        nodes, [squatter],
+        priority_classes=[{"metadata": {"name": "high"}, "value": 500}])
+    disk = _template("d", 200)
+    disk["spec"]["volumes"] = [
+        {"name": "v", "gcePersistentDisk": {"pdName": "pd-1"}}]
+    hi = _template("hi", 1500)
+    hi["spec"]["priorityClassName"] = "high"
+    hi["spec"]["priority"] = 500
+    prof = SchedulerProfile.parity()
+    ref = sweep_interleaved(snap, [disk, hi], prof)
+    got = il.solve_interleaved_tensor(snap, [disk, hi], prof)
+    _assert_same(ref, got, "disk-preempt")
+    assert ref[0].placed_count >= 1          # the disk template placed
+    assert len(set(ref[0].placements)) == ref[0].placed_count  # 1/node max
+    assert 0 in ref[1].placements            # the eviction freed n000
+
+
+def test_rwop_with_preemption_falls_back():
+    """RWOP + possible preemption keeps the object path (the tensor gate
+    rides bind-ever counts, which evictions must not freeze) — and the
+    object path re-places an evicted RWOP clone."""
+    pvcs = [{"metadata": {"name": "exclusive", "namespace": "default"},
+             "spec": {"accessModes": ["ReadWriteOncePod"],
+                      "volumeName": "vol1"}}]
+    pvs = [{"metadata": {"name": "vol1"},
+            "spec": {"accessModes": ["ReadWriteOncePod"]}}]
+    snap = ClusterSnapshot.from_objects(
+        _nodes(2, pods=2), pvcs=pvcs, pvs=pvs,
+        priority_classes=[{"metadata": {"name": "high"}, "value": 500}])
+    rwop = _template("r", 100)
+    rwop["spec"]["volumes"] = [
+        {"name": "v", "persistentVolumeClaim": {"claimName": "exclusive"}}]
+    rwop["spec"]["priority"] = 0
+    hi = _template("hi", 1800)
+    hi["spec"]["priorityClassName"] = "high"
+    hi["spec"]["priority"] = 500
+    prof = SchedulerProfile.parity()
+    assert il.solve_interleaved_tensor(snap, [rwop, hi], prof) is None
+    res = il.sweep_interleaved_auto(snap, [rwop, hi], prof)
+    assert res[0].placed_count >= 1
