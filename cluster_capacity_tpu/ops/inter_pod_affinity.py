@@ -147,6 +147,19 @@ def encode(snapshot: ClusterSnapshot, pod: Mapping,
     pref_aff = _preferred_terms(pod, "podAffinity")
     pref_anti = _preferred_terms(pod, "podAntiAffinity")
 
+    if not (aff_terms or anti_terms or pref_aff or pref_anti) \
+            and not extra_topology_keys and not snapshot.nodes_with_pods():
+        # term-free template against a pod-free snapshot: every field is
+        # pod-independent except the namespace — one encoding per
+        # (snapshot, namespace) serves the whole sweep (and the sweep
+        # dedup's id-cache hashes it once).  With existing pods the pod's
+        # LABELS matter (their anti terms / preferred terms match against
+        # it), so the memo stays off.
+        has_aff_field = bool((pod.get("spec") or {}).get("affinity"))
+        return snapshot.memo(
+            ("ipa_trivial", owner_ns, has_aff_field),
+            lambda: _encode_trivial(snapshot, owner_ns, has_aff_field))
+
     # Group vocabulary over topology keys used by any term.
     keys: List[str] = []
     def group_of(key: str) -> int:
@@ -304,6 +317,44 @@ def encode(snapshot: ClusterSnapshot, pod: Mapping,
         raw_soft_terms=list(soft_terms),
         has_affinity_field=bool((pod.get("spec") or {}).get("affinity")),
     )
+
+
+def _encode_trivial(snapshot: ClusterSnapshot, owner_ns: str,
+                    has_affinity_field: bool) -> AffinityEncoding:
+    """The term-free, pod-free-snapshot encoding — field-for-field what the
+    general path below produces for that case (kept in lockstep by
+    tests/test_interleave_tensor.py + the sweep differentials, which mix
+    trivial and non-trivial templates)."""
+    n = snapshot.num_nodes
+    out = AffinityEncoding(
+        num_aff_terms=0, num_anti_terms=0, max_domains=1,
+        aff_group=np.zeros(1, np.int32), anti_group=np.zeros(1, np.int32),
+        group_keys=[], node_domain=np.full((1, n), -1, dtype=np.int32),
+        aff_init=np.zeros((1, 1)), anti_init=np.zeros((1, 1)),
+        self_aff_match=np.asarray([False]),
+        self_anti_match=np.asarray([False]),
+        escape_allowed=False, existing_anti_static=np.zeros(n, dtype=bool),
+        num_pref_terms=0, pref_group=np.zeros(1, np.int32),
+        pref_weight=np.asarray([0.0]), self_pref_match=np.asarray([False]),
+        static_pref_score=np.zeros(n, dtype=np.float64),
+        has_any_score_terms=False, owner_ns=owner_ns,
+        raw_aff_terms=[], raw_anti_terms=[], raw_soft_terms=[],
+        has_affinity_field=has_affinity_field,
+    )
+    return _freeze_encoding(out)
+
+
+def _freeze_encoding(enc_):
+    """snapshot.memo's freeze contract only covers top-level arrays; a
+    memoized encoding DATACLASS must freeze its own array fields — they
+    are shared by every term-free template of a sweep, and an in-place
+    mutation would otherwise corrupt all of them silently."""
+    import dataclasses
+    for f in dataclasses.fields(enc_):
+        v = getattr(enc_, f.name)
+        if isinstance(v, np.ndarray):
+            v.flags.writeable = False
+    return enc_
 
 
 def group_fold(enc_: AffinityEncoding):

@@ -166,6 +166,23 @@ def encode_system_default(snapshot: ClusterSnapshot,
 def _encode(snapshot: ClusterSnapshot, pod: Mapping,
             constraints: List[dict],
             require_all: bool = True) -> SpreadConstraintSet:
+    if not constraints:
+        # the empty set's arrays depend only on the node count (and the
+        # namespace field for the interleave engine) — one object per
+        # (snapshot, namespace) serves every unconstrained template of a
+        # sweep, and the sweep dedup's id-cache then hashes it once
+        ns = (pod.get("metadata") or {}).get("namespace") or "default"
+        from .inter_pod_affinity import _freeze_encoding
+        return snapshot.memo(
+            ("spread_empty", ns),
+            lambda: _freeze_encoding(
+                _encode_impl(snapshot, pod, [], require_all)))
+    return _encode_impl(snapshot, pod, constraints, require_all)
+
+
+def _encode_impl(snapshot: ClusterSnapshot, pod: Mapping,
+                 constraints: List[dict],
+                 require_all: bool = True) -> SpreadConstraintSet:
     n = snapshot.num_nodes
     c_num = len(constraints)
     namespace = (pod.get("metadata") or {}).get("namespace") or "default"
