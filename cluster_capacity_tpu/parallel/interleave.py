@@ -26,11 +26,13 @@ differential oracle for this engine — tests/test_interleave_tensor.py):
 - host-port templates run natively (r5): a static [T, T] cross-template
   port-conflict matrix times the carried per-template clone counts gives
   each pop's blocked-node mask, sharing the single-template engine's
-  diagnosis slot via _feasibility(ports_blocked=...);
+  diagnosis slot via _feasibility(ports_blocked=...).  Inline-disk and
+  RWOP self-conflicts also run natively via per-template gate scalars ×
+  per-template Carry views (RWOP falls back when preemption is possible:
+  the device gate rides the bind-ever count, not live clones);
 - templates must share one jit specialization (sweep._group_key; the
-  ports flag normalizes out) and the snapshot resource vocabulary; the
-  remaining clone self-conflict gates (inline disks, RWOP, shared DRA
-  claims) stay on the object path.
+  self-conflict flags normalize out) and the snapshot resource
+  vocabulary; shared-DRA colocation stays on the object path.
 
 Queue semantics mirrored exactly (differentially tested):
 - round-robin pops among active templates in arrival order (equal
@@ -76,7 +78,9 @@ class XCarry(NamedTuple):
     requested: "jax.Array"        # f[N, R]   shared
     nonzero: "jax.Array"          # f[N, 2]   shared
     tpl_placed: "jax.Array"       # i32[T, N] per-template clone counts
-                                  # (shared total = tpl_placed.sum(0))
+                                  # (shared total = tpl_placed.sum(0));
+                                  # a [1, 1] ZERO dummy when no ports/disk
+                                  # gate reads it (needs_tpl False)
     sh_cnt: "jax.Array"           # f[T, Ch, N]
     ss_cnt: "jax.Array"           # f[T, Cs, N]
     ssh_cnt: "jax.Array"          # f[T, Cs, N] hostname-row clone counts
@@ -400,9 +404,15 @@ def _xstep(cfg: sim.StaticConfig, sconsts, xconsts, xc: XCarry):
     c_t["ss_node_existing"] = c_t["ss_node_existing"] + _idx(xc.ssh_cnt, t)
     c_t["ss_self"] = jnp.zeros_like(c_t["ss_self"])
 
+    # tpl_placed is carried at full [T, N] only when some gate reads it
+    # (host ports / inline disks); otherwise it is a [1, 1] dummy and the
+    # 200KB-per-pop carry write + conflict matmul vanish at trace time
+    track_tpl = xc.tpl_placed.shape == (t_n, xc.requested.shape[0])
+    own_placed = _idx(xc.tpl_placed, t) if track_tpl \
+        else jnp.zeros(xc.requested.shape[0], dtype=jnp.int32)
     view = sim.Carry(
         requested=xc.requested, nonzero=xc.nonzero,
-        placed=_idx(xc.tpl_placed, t),   # OWN clones (single-template view)
+        placed=own_placed,               # OWN clones (single-template view)
         sh_cnt=_idx(xc.sh_cnt, t), ss_cnt=_idx(xc.ss_cnt, t),
         aff_cnt=_idx(xc.aff_cnt, t), anti_cnt=_idx(xc.anti_cnt, t),
         pref_cnt=_idx(xc.pref_cnt, t), aff_total=xc.aff_total[t],
@@ -411,8 +421,12 @@ def _xstep(cfg: sim.StaticConfig, sconsts, xconsts, xc: XCarry):
 
     # host-port conflicts from ANY template's clones (incl. own): the
     # object path reaches the same verdicts through the shared pod roster
-    conflict_row = _idx(xconsts["port_conflict"], t)       # [T]
-    ports_blocked = (conflict_row @ (xc.tpl_placed > 0).astype(dt)) > 0.5
+    if track_tpl:
+        conflict_row = _idx(xconsts["port_conflict"], t)   # [T]
+        ports_blocked = (conflict_row
+                         @ (xc.tpl_placed > 0).astype(dt)) > 0.5
+    else:
+        ports_blocked = None
     feasible, parts = sim._feasibility(cfg, c_t, view,
                                        eanti_dyn=_idx(xc.eanti_cnt, t),
                                        ports_blocked=ports_blocked)
@@ -455,11 +469,12 @@ def _xstep(cfg: sim.StaticConfig, sconsts, xconsts, xc: XCarry):
     else:
         ipa_fail = jnp.zeros(n_nodes, dtype=bool)
     base_ok = c_t["static_mask"] & fit_ok & c_t["volume_mask"]
-    # dynamic port conflicts attribute BEFORE fit (filter-chain order), so
-    # any statically-clean blocked node carries the curable ports reason
     curable_node = _idx(xconsts["static_ports_fail"], t) | \
-        (c_t["static_mask"] & ports_blocked) | \
         (base_ok & (sm | ~s_ok | ipa_fail))
+    if ports_blocked is not None:
+        # dynamic port conflicts attribute BEFORE fit (filter-chain order),
+        # so any statically-clean blocked node carries the curable reason
+        curable_node = curable_node | (c_t["static_mask"] & ports_blocked)
     curable_now = jnp.any(curable_node)
     # A template that could preempt (some pod in the system sits strictly
     # below its priority) must halt on EVERY failure: the object path runs
@@ -475,10 +490,14 @@ def _xstep(cfg: sim.StaticConfig, sconsts, xconsts, xc: XCarry):
                              (gate * c_t["req_vec"])[None, :])
     nonzero = sim._row_add(xc.nonzero, chosen,
                            (gate * c_t["req_nonzero"])[None, :])
-    chosen_onehot = jnp.arange(xc.tpl_placed.shape[1],
-                               dtype=jnp.int32) == chosen
-    tpl_placed = xc.tpl_placed + (onehot_t[:, None] & chosen_onehot[None, :]
-                                  & do).astype(jnp.int32)
+    if track_tpl:
+        chosen_onehot = jnp.arange(xc.requested.shape[0],
+                                   dtype=jnp.int32) == chosen
+        tpl_placed = xc.tpl_placed + (onehot_t[:, None]
+                                      & chosen_onehot[None, :]
+                                      & do).astype(jnp.int32)
+    else:
+        tpl_placed = xc.tpl_placed
 
     sh_cnt, ss_cnt, ssh_cnt = xc.sh_cnt, xc.ss_cnt, xc.ssh_cnt
     if cfg.spread_hard_n > 0:
@@ -497,7 +516,7 @@ def _xstep(cfg: sim.StaticConfig, sconsts, xconsts, xc: XCarry):
         ss_cnt = xc.ss_cnt + hit.astype(dt) * inc[:, :, None]
         # hostname rows: matching-clones-on-the-node counts, ungated by the
         # inclusion policy (hostname_cnt parity with simulator._scores)
-        n = xc.tpl_placed.shape[1]
+        n = xc.requested.shape[0]
         node_onehot = (jnp.arange(n, dtype=jnp.int32) == chosen).astype(dt)
         inc_h = xrow * sconsts["ss_host"].astype(dt) * gate    # [T, Cs]
         ssh_cnt = xc.ssh_cnt + inc_h[:, :, None] * node_onehot[None, None, :]
@@ -697,6 +716,13 @@ def solve_interleaved_tensor(snapshot: ClusterSnapshot,
     pbs, cfg, dnh, consts_list, sconsts, xconsts, dt = encode_group(snap_cur)
     f = lambda a: jnp.asarray(a, dtype=dt)
 
+    # carry per-template clone counts at full [T, N] only when a gate
+    # reads them (ports / inline disks) — otherwise a [1, 1] dummy saves a
+    # full-tensor carry write on every pop
+    needs_tpl = any(pbs_all[i].clone_has_host_ports
+                    or pbs_all[i].volume_self_conflict
+                    for i in solve_idx)
+
     def fresh_xcarry(k_counts, active_np, parked_np, last_seq_np,
                      next_start_np, seq_next_v, quota_v):
         g = pbs[0].ipa.node_domain.shape[0]
@@ -708,7 +734,8 @@ def solve_interleaved_tensor(snapshot: ClusterSnapshot,
             # eviction rebuild: surviving clones are baked into the
             # re-encoded snapshot (static port masks included), exactly
             # like the carried spread/affinity counts
-            tpl_placed=jnp.zeros((t_n, n), dtype=jnp.int32),
+            tpl_placed=jnp.zeros((t_n, n) if needs_tpl else (1, 1),
+                                 dtype=jnp.int32),
             sh_cnt=sconsts["sh_cnt_init"],
             ss_cnt=sconsts["ss_cnt_init"],
             ssh_cnt=jnp.zeros((t_n, cs, n), dtype=dt),
@@ -743,9 +770,11 @@ def solve_interleaved_tensor(snapshot: ClusterSnapshot,
                       np.zeros(t_n), t_n, budget)
 
     def view_of(ti: int):
+        own = xc.tpl_placed[ti] if needs_tpl \
+            else jnp.zeros(n, dtype=jnp.int32)
         return sim.Carry(
             requested=xc.requested, nonzero=xc.nonzero,
-            placed=xc.tpl_placed[ti],
+            placed=own,
             sh_cnt=xc.sh_cnt[ti], ss_cnt=xc.ss_cnt[ti],
             aff_cnt=xc.aff_cnt[ti], anti_cnt=xc.anti_cnt[ti],
             pref_cnt=xc.pref_cnt[ti], aff_total=xc.aff_total[ti],
@@ -753,6 +782,8 @@ def solve_interleaved_tensor(snapshot: ClusterSnapshot,
             next_start=xc.next_start[ti], rng=jax.random.PRNGKey(0))
 
     def ports_blocked_of(ti: int):
+        if not needs_tpl:
+            return None
         conflict = np.asarray(xconsts["port_conflict"])[ti]       # [T]
         live = np.asarray(xc.tpl_placed) > 0                      # [T, N]
         return jnp.asarray(conflict @ live.astype(np.float64) > 0.5)
