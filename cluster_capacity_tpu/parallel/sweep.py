@@ -172,11 +172,15 @@ def sweep(snapshot: ClusterSnapshot, templates: Sequence[dict],
     groups: Dict[tuple, List[int]] = {}
     fp_groups: Dict[tuple, List[int]] = {}
     rest_idx: List[int] = []
-    small_limit = bool(max_limit) and max_limit <= 4096
+    # the batched analytic solve is single-device; under a mesh it stays
+    # off — fully-eligible templates then take the (also single-device,
+    # exact) unbounded analytic path, and only batchable groups of 2+ run
+    # the sharded scan
+    small_limit = bool(max_limit) and max_limit <= 4096 and mesh is None
     for i in rep_idx:
         pb = problems[i]
         if not small_limit and fast_path.eligible(pb):
-            rest_idx.append(i)
+            rest_idx.append(i)    # unbounded analytic (pre-mesh semantics)
         elif small_limit and fast_path.eligible_limited(pb):
             key = _group_key(pb, sim.static_config(pb))
             fp_groups.setdefault(key, []).append(i)
