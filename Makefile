@@ -20,9 +20,12 @@ $(NATIVE_LIB): native/ccsnap.cpp
 
 # Format/boilerplate gate (reference: make verify-gofmt + golangci-lint +
 # verify-boilerplate.sh, /root/reference/Makefile:41,54-66).  Self-contained:
-# the image ships no Python linter.
+# the image ships no Python linter.  jaxlint is the JAX/TPU antipattern
+# analysis (trace-safety, recompile-hazard, host-sync, dtype-discipline)
+# over cluster_capacity_tpu/ — see doc/architecture.md for the rule table.
 lint:
 	$(PY) tools/lint.py
+	$(PY) -m tools.jaxlint
 
 # Unit + behavioral suite (fake in-memory clusters; no hardware needed).
 test-unit:

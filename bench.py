@@ -638,8 +638,14 @@ def _trend_check(out: dict) -> None:
     regressions like r4's scan −6% should be caught by the builder, not
     the judge."""
     import glob
-    files = sorted(glob.glob(os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "BENCH_r*.json")))
+    import re
+    # numeric round sort: lexicographic order would rank BENCH_r100 below
+    # BENCH_r11 and compare against a stale round
+    files = sorted(
+        glob.glob(os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_r*.json")),
+        key=lambda p: (int(m.group(1)) if (m := re.search(
+            r"BENCH_r(\d+)\.json$", p)) else -1, p))
     if not files:
         return
     try:

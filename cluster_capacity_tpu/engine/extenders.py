@@ -17,6 +17,7 @@ callables (tests / embedding).
 
 from __future__ import annotations
 
+import functools
 import json
 import urllib.request
 from dataclasses import dataclass, field
@@ -328,13 +329,34 @@ def run_filter_chain(extenders, pod: dict, node_names: List[str],
     return names
 
 
+@functools.lru_cache(maxsize=None)        # zero-arg: exactly one entry
+def _extender_kernels():
+    """Jitted compute/apply pair for the host-driven loop, hoisted to
+    module scope so repeated solve_with_extenders calls share one trace
+    cache instead of retracing per invocation."""
+    import functools as ft
+
+    import jax
+    import jax.numpy as jnp
+
+    @ft.partial(jax.jit, static_argnames=("cfg",))
+    def compute(cfg, consts, carry):
+        feasible, _ = sim._feasibility(cfg, consts, carry)
+        total = sim._scores(cfg, consts, carry, feasible)
+        return feasible, total
+
+    @ft.partial(jax.jit, static_argnames=("cfg",))
+    def apply(cfg, consts, carry, chosen):
+        place = jnp.asarray(True)
+        return sim._apply_placement(cfg, consts, carry, chosen, place)
+
+    return compute, apply
+
+
 def solve_with_extenders(pb: enc.EncodedProblem,
                          extenders: Sequence[ExtenderConfig],
                          max_limit: int = 0) -> sim.SolveResult:
     """Host-driven greedy loop with extender calls each cycle."""
-    import functools
-
-    import jax
     import jax.numpy as jnp
 
     if pb.snapshot.num_nodes == 0 or pb.pod_level_reason:
@@ -348,16 +370,7 @@ def solve_with_extenders(pb: enc.EncodedProblem,
     name_to_idx = {n: i for i, n in enumerate(names)}
     node_objs = {n: o for n, o in zip(names, pb.snapshot.nodes)}
 
-    @functools.partial(jax.jit, static_argnames=("cfg",))
-    def compute(cfg, consts, carry):
-        feasible, _ = sim._feasibility(cfg, consts, carry)
-        total = sim._scores(cfg, consts, carry, feasible)
-        return feasible, total
-
-    @functools.partial(jax.jit, static_argnames=("cfg",))
-    def apply(cfg, consts, carry, chosen):
-        place = jnp.asarray(True)
-        return sim._apply_placement(cfg, consts, carry, chosen, place)
+    compute, apply = _extender_kernels()
 
     budget = pb.max_steps_hint + 1
     if max_limit and max_limit > 0:

@@ -278,7 +278,7 @@ def solve_fast(pb: enc.EncodedProblem, max_limit: int = 0
     order = jnp.argsort(-flat_scores, stable=True)
     chosen_nodes = node_ids[order][:budget]
 
-    placements = np.asarray(chosen_nodes).astype(int).tolist()
+    placements = np.asarray(chosen_nodes).astype(np.int64).tolist()
     placed = len(placements)
 
     if max_limit and placed >= max_limit:
@@ -299,7 +299,7 @@ def solve_fast(pb: enc.EncodedProblem, max_limit: int = 0
 
     # Exhausted capacity → reconstruct the final state and diagnose.
     counts = np.bincount(placements, minlength=n) if placements else \
-        np.zeros(n, dtype=int)
+        np.zeros(n, dtype=np.int64)
     final_requested = pb.init_requested + np.outer(counts, pb.req_vec)
     final_nonzero = pb.init_nonzero + np.outer(counts, pb.req_nonzero)
     carry = sim._init_carry(pb, consts, pb.profile.seed)
@@ -402,7 +402,11 @@ def _unique_rows(rows, n: int, dt):
 import functools
 
 
-@functools.lru_cache(maxsize=None)
+# Bounded: under --watch mode every snapshot delta can shift K (the max
+# per-node capacity), and an unbounded cache would accumulate one compiled
+# executable per distinct K for the life of the process.  Callers quantize
+# K to the next power of two so nearby capacities share an entry.
+@functools.lru_cache(maxsize=64)
 def _fast_batch_device(strategy: str, fit_shape, K: int, m: int, n: int,
                        w_fit: float, w_bal: float, w_t: float, w_na: float,
                        w_il: float, dt_name: str):
@@ -586,6 +590,12 @@ def _fast_batch_chunk(sub, caps_list, budgets, cfg, max_limit: int):
 
     caps = np.stack(caps_list).astype(np.int32)
     m = min(max_limit, n * K)
+    # Quantize the k-axis extent to the next power of two: `valid = k < caps`
+    # masks the padded slots to -inf and the node-major flat order is
+    # unchanged, so selection is bit-identical while snapshots with nearby
+    # max capacities share one compiled kernel (m stays derived from the
+    # true K so the scan-vs-top_k branch choice is unaffected).
+    K = 1 << max(0, K - 1).bit_length()
     run = _fast_batch_device(
         cfg.fit_strategy_type, cfg.fit_shape, K, m, n,
         w_fit, w_bal, w_t, w_na, w_il, profile.compute_dtype or "float32")
@@ -603,7 +613,7 @@ def _fast_batch_chunk(sub, caps_list, budgets, cfg, max_limit: int):
             # -> per-template fallback
             results.append(None)
             continue
-        placements = chosen_np[bi, :budgets[bi]].astype(int).tolist()
+        placements = chosen_np[bi, :budgets[bi]].astype(np.int64).tolist()
         results.append(sim.SolveResult(
             placements=placements, placed_count=len(placements),
             fail_type=sim.FAIL_LIMIT_REACHED,

@@ -570,8 +570,9 @@ def _build_kernel(pk: _Packing, k_steps: int):
                 def bs_body(_, lo_hi):
                     lo, hi = lo_hi
                     mid = (lo + hi) // 2
+                    # counts 0/1 over n nodes: int32 is ample, say so
                     cnt = jnp.sum((feasible & (rank <= mid))
-                                  .astype(jnp.int32))
+                                  .astype(jnp.int32), dtype=jnp.int32)
                     return jnp.where(cnt >= kk, lo, mid + 1), \
                         jnp.where(cnt >= kk, mid, hi)
 

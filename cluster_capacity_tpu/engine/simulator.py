@@ -557,7 +557,9 @@ def _sample_scorable(cfg: StaticConfig, feasible, next_start):
     rank = jnp.remainder(idx - next_start, n)
     rot = jax.lax.dynamic_slice_in_dim(
         jnp.concatenate([feasible, feasible]), next_start, n)
-    csum = jnp.cumsum(rot.astype(jnp.int32))
+    # 0/1 values summed over n <= the node-count cap << 2**31: the int32
+    # prefix sum cannot overflow.
+    csum = jnp.cumsum(rot.astype(jnp.int32))  # jaxlint: disable=DT002
     reached = csum >= min(cfg.sample_k, n)
     threshold = jnp.where(jnp.any(reached),
                           jnp.argmax(reached).astype(jnp.int32), n - 1)
@@ -870,10 +872,7 @@ def solve(pb: enc.EncodedProblem, max_limit: int = 0,
     if mesh is not None and jax.process_count() > 1:
         # gather the node-sharded carry to every host for diagnosis (one
         # all-gather over DCN at the very end of the solve)
-        from jax.sharding import NamedSharding, PartitionSpec as P
-        replicate = jax.jit(lambda c: c, out_shardings=jax.tree.map(
-            lambda _: NamedSharding(mesh, P()), carry))
-        carry = jax.tree.map(np.asarray, replicate(carry))
+        carry = jax.tree.map(np.asarray, _replicator(mesh)(carry))
     if stopped:
         counts = diagnose(pb, cfg, host_consts, carry)
         msg = format_fit_error(pb.snapshot.num_nodes, counts)
@@ -889,6 +888,17 @@ def solve(pb: enc.EncodedProblem, max_limit: int = 0,
                                      f"{placed} placements; set max_limit to "
                                      f"bound unlimited profiles"),
                        node_names=pb.snapshot.node_names)
+
+
+@functools.lru_cache(maxsize=8)
+def _replicator(mesh):
+    """Jitted identity that gathers a node-sharded carry to every host;
+    the single out_sharding is a pytree prefix, broadcast to every carry
+    leaf.  Cached per mesh so repeated multi-host solves reuse one
+    compiled all-gather instead of retracing at the end of each solve."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    return jax.jit(lambda c: c, out_shardings=NamedSharding(mesh, P()))
 
 
 def diagnose(pb: enc.EncodedProblem, cfg: StaticConfig, consts,

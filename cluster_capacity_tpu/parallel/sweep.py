@@ -217,7 +217,15 @@ def sweep(snapshot: ClusterSnapshot, templates: Sequence[dict],
         import dataclasses as _dc
         for i, j in dup_of.items():
             r = results[j]
-            results[i] = _dc.replace(r) if _dc.is_dataclass(r) else r
+            # replace() copies the dataclass but still aliases its mutable
+            # fields; give each duplicate its own placements/fail_counts so
+            # a caller mutating one result can't corrupt its class siblings
+            # (node_names stays shared — it is read-only by convention).
+            if _dc.is_dataclass(r):
+                results[i] = _dc.replace(r, placements=list(r.placements),
+                                         fail_counts=dict(r.fail_counts))
+            else:
+                results[i] = r
     return results  # type: ignore[return-value]
 
 

@@ -215,3 +215,31 @@ def test_interleave_flag(tmp_path, capsys):
     out = capsys.readouterr().out
     # 1000m / 500m = 2 slots SHARED: one each under round-robin
     assert out.count("can schedule 1 instance(s)") == 2
+
+
+def test_ci_strip_comment_respects_quotes(tmp_path, monkeypatch):
+    """The fallback ci.yaml reader must not truncate a quoted scalar at a
+    `#` — `pytest -k "not slow # regression"` is a legal run line."""
+    from tools.ci import _load_steps, _strip_comment
+
+    assert _strip_comment('run: make lint  # gate') == 'run: make lint  '
+    assert _strip_comment('run: pytest -k "a # b"') == 'run: pytest -k "a # b"'
+    assert _strip_comment("run: grep '#x' f  # tail") == "run: grep '#x' f  "
+    assert _strip_comment('# whole-line comment') == ''
+
+    cfg = tmp_path / "ci.yaml"
+    cfg.write_text(
+        'timeout: 90  # total\n'
+        'steps:\n'
+        '  - name: quoted\n'
+        '    # a comment line between keys\n'
+        '    run: pytest -k "not slow # or flaky"\n'
+        '      -q  # continuation with comment\n'
+        '    timeout: 30  # per-step\n')
+    # force the fallback parser even when PyYAML is installed
+    monkeypatch.setitem(sys.modules, "yaml", None)
+    steps, total = _load_steps(str(cfg))
+    assert total == 90
+    assert steps == [{"name": "quoted",
+                      "run": 'pytest -k "not slow # or flaky" -q',
+                      "timeout": 30}]

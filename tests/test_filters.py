@@ -269,3 +269,132 @@ def test_rtc_shape_matches_go_broker():
             got = np.asarray(piecewise_shape(
                 jnp.asarray(utils, dtype=dt), xs, ys))
             assert np.array_equal(want, got), (xs, ys)
+
+
+# ---------------------------------------------------------------------------
+# Differential: vectorized node-selector matching vs the scalar reference
+# (the contract promised at models/labels.py's vectorized section header)
+# ---------------------------------------------------------------------------
+
+def _random_label_snapshot(rng, n=40):
+    """Nodes with random label maps mixing parseable and unparseable ints
+    (exercises Gt/Lt's parse-failure masking) and missing keys."""
+    import numpy as np
+    from cluster_capacity_tpu.models.snapshot import ClusterSnapshot
+
+    values = ["1", "5", "10", "-3", "007", "large", "12a", "", "x"]
+    nodes = []
+    for i in range(n):
+        labels = {}
+        for key in ("zone", "tier", "num"):
+            if rng.rand() < 0.8:
+                labels[key] = values[rng.randint(len(values))]
+        nodes.append(build_test_node(f"n{i}", 1000, int(1e9), 10,
+                                     labels=labels))
+    snap = ClusterSnapshot.from_objects(nodes)
+    by_name = {(nd.get("metadata") or {}).get("name"):
+               (nd.get("metadata") or {}).get("labels") or {}
+               for nd in nodes}
+    # order label maps by the snapshot's node axis, not the input list
+    label_maps = [by_name[nm] for nm in snap.node_names]
+    return snap, label_maps
+
+
+def _random_requirement(rng):
+    ops = ["In", "NotIn", "Exists", "DoesNotExist", "Gt", "Lt"]
+    values = ["1", "5", "10", "-3", "007", "large", "12a", "x", "absent"]
+    op = ops[rng.randint(len(ops))]
+    expr = {"key": ["zone", "tier", "num", "missing"][rng.randint(4)],
+            "operator": op}
+    if op in ("In", "NotIn"):
+        k = rng.randint(1, 4)
+        expr["values"] = [values[rng.randint(len(values))]
+                         for _ in range(k)]
+    elif op in ("Gt", "Lt"):
+        # sometimes unparseable, sometimes the wrong arity
+        pool = ["3", "-1", "10", "junk"]
+        k = 1 if rng.rand() < 0.8 else rng.randint(0, 3)
+        expr["values"] = [pool[rng.randint(len(pool))] for _ in range(k)]
+    return expr
+
+
+def _random_term(rng, names):
+    term = {}
+    ne = rng.randint(0, 3)
+    if ne:
+        term["matchExpressions"] = [_random_requirement(rng)
+                                    for _ in range(ne)]
+    if rng.rand() < 0.4:
+        pool = list(names[:5]) + ["ghost"]
+        k = rng.randint(1, 4)
+        term["matchFields"] = [{
+            "key": "metadata.name" if rng.rand() < 0.9 else "metadata.uid",
+            "operator": "In" if rng.rand() < 0.5 else "NotIn",
+            "values": [pool[rng.randint(len(pool))] for _ in range(k)]}]
+    return term       # may be empty: must match nothing on both paths
+
+
+def test_vectorized_matches_scalar_requirements_and_terms():
+    import numpy as np
+    from cluster_capacity_tpu.models import labels as L
+
+    rng = np.random.RandomState(7)
+    snap, label_maps = _random_label_snapshot(rng)
+    names = snap.node_names
+    for _ in range(200):
+        expr = _random_requirement(rng)
+        got = L.node_selector_requirement_mask(snap, expr)
+        want = [L._match_node_selector_requirement(expr, lm)
+                for lm in label_maps]
+        assert got.tolist() == want, expr
+    for _ in range(200):
+        term = _random_term(rng, names)
+        got = L.node_selector_term_mask(snap, term)
+        want = [L.match_node_selector_term(term, lm, nm)
+                for lm, nm in zip(label_maps, names)]
+        assert got.tolist() == want, term
+
+
+def test_vectorized_matches_scalar_selector_and_affinity():
+    import numpy as np
+    from cluster_capacity_tpu.models import labels as L
+
+    rng = np.random.RandomState(11)
+    snap, label_maps = _random_label_snapshot(rng)
+    names = snap.node_names
+    # nil selector matches everything; zero terms match nothing
+    assert L.node_selector_mask(snap, None).all()
+    assert not L.node_selector_mask(snap, {"nodeSelectorTerms": []}).any()
+    for _ in range(120):
+        sel = {"nodeSelectorTerms": [_random_term(rng, names)
+                                     for _ in range(rng.randint(0, 4))]}
+        got = L.node_selector_mask(snap, sel)
+        want = [L.match_node_selector(sel, lm, nm)
+                for lm, nm in zip(label_maps, names)]
+        assert got.tolist() == want, sel
+    for _ in range(120):
+        spec = {}
+        if rng.rand() < 0.5:
+            spec["nodeSelector"] = {
+                "zone": ["1", "large", "nope"][rng.randint(3)]}
+        aff = {}
+        if rng.rand() < 0.8:
+            aff["requiredDuringSchedulingIgnoredDuringExecution"] = {
+                "nodeSelectorTerms": [_random_term(rng, names)
+                                      for _ in range(rng.randint(0, 3))]}
+        prefs = []
+        for _ in range(rng.randint(0, 4)):
+            prefs.append({"weight": int(rng.randint(1, 101)),
+                          "preference": _random_term(rng, names)})
+        if prefs:
+            aff["preferredDuringSchedulingIgnoredDuringExecution"] = prefs
+        if aff:
+            spec["affinity"] = {"nodeAffinity": aff}
+        got_mask = L.selector_and_affinity_mask(snap, spec)
+        want_mask = [L.pod_matches_node_selector_and_affinity(spec, lm, nm)
+                     for lm, nm in zip(label_maps, names)]
+        assert got_mask.tolist() == want_mask, spec
+        got_sc = L.preferred_node_affinity_scores(snap, spec)
+        want_sc = [float(L.preferred_node_affinity_score(spec, lm, nm))
+                   for lm, nm in zip(label_maps, names)]
+        assert got_sc.tolist() == want_sc, spec

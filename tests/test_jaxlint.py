@@ -1,0 +1,579 @@
+"""jaxlint rule pins (one positive + one negative case per rule), the
+suppression/baseline mechanics, and the runtime retrace-budget harness:
+each jitted entry point (fused._compiled_call, fused_batched, fast_path,
+sweep, extenders) must compile exactly once per static geometry."""
+
+import logging
+import os
+
+from tools.jaxlint import lint_source
+from tools.jaxlint import baseline as bl
+from tools.jaxlint.common import Finding, RULES, parse_suppressions
+
+from helpers import build_test_node, build_test_pod
+
+ENGINE = "cluster_capacity_tpu/engine/_mem.py"     # host-sync hot dir
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# trace-safety
+# ---------------------------------------------------------------------------
+
+def test_ts001_branch_on_traced_value():
+    src = '''"""m."""
+import jax
+
+@jax.jit
+def f(x):
+    if x > 0:
+        return x
+    return -x
+'''
+    assert "TS001" in rules_of(lint_source(src))
+
+
+def test_ts001_negative_branch_on_shape_and_static():
+    src = '''"""m."""
+import functools
+import jax
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def f(x, cfg):
+    if x.shape[0] > 4 and cfg:
+        return x * 2
+    return x
+'''
+    assert "TS001" not in rules_of(lint_source(src))
+
+
+def test_ts001_while_and_taint_through_helper():
+    """Taint crosses an ordinary call: helper's param becomes traced."""
+    src = '''"""m."""
+import jax
+
+def helper(v):
+    while v > 0:
+        v = v - 1
+    return v
+
+@jax.jit
+def f(x):
+    y = x * 3
+    return helper(y)
+'''
+    fs = lint_source(src)
+    assert any(f.rule == "TS001" and "while" in f.message.lower()
+               for f in fs)
+
+
+def test_ts002_float_concretization():
+    src = '''"""m."""
+import jax
+
+@jax.jit
+def f(x):
+    return float(x)
+'''
+    assert "TS002" in rules_of(lint_source(src))
+
+
+def test_ts002_negative_len_and_is():
+    src = '''"""m."""
+import jax
+
+@jax.jit
+def f(x, opt=None):
+    k = float(len(x.shape))
+    flag = opt is None
+    return x * k if flag else x
+'''
+    assert "TS002" not in rules_of(lint_source(src))
+
+
+def test_ts003_item_on_traced_value():
+    src = '''"""m."""
+import jax
+
+@jax.jit
+def f(x):
+    return x.sum().item()
+'''
+    assert "TS003" in rules_of(lint_source(src))
+
+
+def test_ts003_negative_item_outside_trace():
+    src = '''"""m."""
+import numpy as np
+
+def f(x):
+    return np.asarray(x).sum().item()
+'''
+    assert "TS003" not in rules_of(lint_source(src))
+
+
+# ---------------------------------------------------------------------------
+# recompile-hazard
+# ---------------------------------------------------------------------------
+
+def test_rc001_jit_per_call():
+    src = '''"""m."""
+import jax
+
+def solve_once(c):
+    replicate = jax.jit(lambda x: x)
+    return replicate(c)
+'''
+    assert "RC001" in rules_of(lint_source(src))
+
+
+def test_rc001_negative_cached_factory_and_returned_jit():
+    src = '''"""m."""
+import functools
+import jax
+
+@functools.lru_cache(maxsize=None)
+def _runner():
+    @jax.jit
+    def run(c):
+        return c
+    return run
+
+def make_runner():
+    f = jax.jit(lambda x: x)
+    return f
+'''
+    assert "RC001" not in rules_of(lint_source(src))
+
+
+def test_rc002_unbounded_parametrised_factory():
+    src = '''"""m."""
+import functools
+import jax
+
+@functools.lru_cache(maxsize=None)
+def _kernel(k):
+    @jax.jit
+    def run(x):
+        return x[:k]
+    return run
+'''
+    assert "RC002" in rules_of(lint_source(src))
+
+
+def test_rc002_negative_bounded_or_zero_arg():
+    src = '''"""m."""
+import functools
+import jax
+
+@functools.lru_cache(maxsize=64)
+def _kernel(k):
+    @jax.jit
+    def run(x):
+        return x[:k]
+    return run
+
+@functools.lru_cache(maxsize=None)
+def _zero_arg():
+    @jax.jit
+    def run(x):
+        return x
+    return run
+'''
+    assert "RC002" not in rules_of(lint_source(src))
+
+
+def test_rc003_unhashable_static_argument():
+    src = '''"""m."""
+import functools
+import jax
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def f(x, cfg):
+    return x
+
+def driver(x):
+    return f(x, cfg=[1, 2])
+'''
+    assert "RC003" in rules_of(lint_source(src))
+
+
+def test_rc003_negative_hashable_static():
+    src = '''"""m."""
+import functools
+import jax
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def f(x, cfg):
+    return x
+
+def driver(x):
+    return f(x, cfg=(1, 2))
+'''
+    assert "RC003" not in rules_of(lint_source(src))
+
+
+def test_rc004_closure_over_per_call_array():
+    src = '''"""m."""
+import jax
+import jax.numpy as jnp
+
+def solve(xs):
+    w = jnp.ones(4)
+
+    @jax.jit
+    def score(x):
+        return x * w
+    return [score(x) for x in xs]
+'''
+    assert "RC004" in rules_of(lint_source(src))
+
+
+def test_rc004_negative_cached_factory_capture():
+    src = '''"""m."""
+import functools
+import jax
+import jax.numpy as jnp
+
+@functools.lru_cache(maxsize=8)
+def _scorer(n):
+    w = jnp.ones(n)
+
+    @jax.jit
+    def score(x):
+        return x * w
+    return score
+'''
+    assert "RC004" not in rules_of(lint_source(src))
+
+
+# ---------------------------------------------------------------------------
+# host-sync (only polices engine//parallel//ops paths)
+# ---------------------------------------------------------------------------
+
+def test_hs001_block_until_ready_in_hot_path():
+    src = '''"""m."""
+def drive(y):
+    y.block_until_ready()
+    return y
+'''
+    assert "HS001" in rules_of(lint_source(src, path=ENGINE))
+    # same code outside the hot path: no finding
+    assert "HS001" not in rules_of(lint_source(src))
+
+
+def test_hs001_negative_in_designated_sync_point():
+    src = '''"""m."""
+def solve(y):
+    y.block_until_ready()
+    return y
+'''
+    assert "HS001" not in rules_of(lint_source(src, path=ENGINE))
+
+
+def test_hs002_device_get():
+    src = '''"""m."""
+import jax
+
+def drive(y):
+    return jax.device_get(y)
+'''
+    assert "HS002" in rules_of(lint_source(src, path=ENGINE))
+
+
+def test_hs002_negative_whitelisted():
+    src = '''"""m."""
+import jax
+
+def collect(y):
+    return jax.device_get(y)
+'''
+    assert "HS002" not in rules_of(lint_source(src, path=ENGINE))
+
+
+def test_hs003_item_in_loop_on_device_value():
+    src = '''"""m."""
+import jax.numpy as jnp
+
+def drive(a, b):
+    y = jnp.add(a, b)
+    out = []
+    for i in range(4):
+        out.append(y.item())
+    return out
+'''
+    assert "HS003" in rules_of(lint_source(src, path=ENGINE))
+
+
+def test_hs003_negative_readback_after_loop():
+    src = '''"""m."""
+import jax.numpy as jnp
+
+def drive(a, b):
+    y = jnp.add(a, b)
+    out = []
+    for i in range(4):
+        out.append(i)
+    return out, y.item()
+'''
+    assert "HS003" not in rules_of(lint_source(src, path=ENGINE))
+
+
+# ---------------------------------------------------------------------------
+# dtype-discipline
+# ---------------------------------------------------------------------------
+
+def test_dt001_builtin_dtype():
+    src = '''"""m."""
+import numpy as np
+
+def f(n):
+    a = np.zeros(n, dtype=int)
+    return a.astype(float)
+'''
+    assert {f.rule for f in lint_source(src)} >= {"DT001"}
+    assert len([f for f in lint_source(src) if f.rule == "DT001"]) == 2
+
+
+def test_dt001_negative_explicit_widths():
+    src = '''"""m."""
+import numpy as np
+
+def f(n):
+    a = np.zeros(n, dtype=np.int64)
+    return a.astype(np.float64)
+'''
+    assert "DT001" not in rules_of(lint_source(src))
+
+
+def test_dt002_int32_reduction():
+    src = '''"""m."""
+import jax.numpy as jnp
+
+def f(x):
+    return jnp.cumsum(x.astype(jnp.int32))
+'''
+    assert "DT002" in rules_of(lint_source(src))
+
+
+def test_dt002_negative_explicit_accumulator():
+    src = '''"""m."""
+import jax.numpy as jnp
+
+def f(x):
+    a = jnp.cumsum(x.astype(jnp.int32), dtype=jnp.int64)
+    b = jnp.sum(x.astype(jnp.int64))
+    return a, b
+'''
+    assert "DT002" not in rules_of(lint_source(src))
+
+
+# ---------------------------------------------------------------------------
+# suppressions + baseline mechanics
+# ---------------------------------------------------------------------------
+
+def test_inline_suppression_and_disable_file():
+    src = '''"""m."""
+import numpy as np
+
+def f(n):
+    return np.zeros(n, dtype=int)  # jaxlint: disable=DT001
+'''
+    assert lint_source(src) == []
+    src_file = src.replace("  # jaxlint: disable=DT001", "").replace(
+        '"""m."""', '"""m."""  # jaxlint: disable-file=DT001')
+    assert lint_source(src_file) == []
+    per_line, per_file = parse_suppressions("x = 1  # jaxlint: disable\n")
+    assert per_line == {1: {"*"}} and per_file == set()
+
+
+def test_rules_registry_covers_all_emitted_rules():
+    assert set(RULES) == {"TS001", "TS002", "TS003", "RC001", "RC002",
+                          "RC003", "RC004", "HS001", "HS002", "HS003",
+                          "DT001", "DT002"}
+
+
+def test_baseline_split_and_hot_path_gate():
+    f1 = Finding("cluster_capacity_tpu/cli.py", 3, "DT001", "msg-a")
+    f2 = Finding("cluster_capacity_tpu/cli.py", 9, "DT001", "msg-b")
+    entries = [{"path": f1.path, "rule": f1.rule, "message": f1.message},
+               {"path": "x.py", "rule": "TS001", "message": "gone"}]
+    new, stale = bl.split([f1, f2], entries)
+    assert new == [f2]
+    assert stale == [("x.py", "TS001", "gone")]
+    hot = bl.hot_path_entries([{
+        "path": "cluster_capacity_tpu/engine/sim.py", "rule": "TS001",
+        "message": "m"}] + entries)
+    assert len(hot) == 1
+
+
+def test_tree_is_clean_and_fast():
+    """The acceptance gate itself: four passes over the real tree, zero
+    new findings, zero hot-path baseline entries, well under 60s."""
+    import time
+
+    from tools.jaxlint import lint_files
+    from tools.jaxlint.config import BASELINE_PATH, TARGET_DIRS
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    rels = []
+    for root in TARGET_DIRS:
+        for dirpath, _d, files in os.walk(os.path.join(repo, root)):
+            rels += [os.path.relpath(os.path.join(dirpath, fn), repo)
+                     for fn in files if fn.endswith(".py")]
+    t0 = time.time()
+    findings = lint_files(repo, sorted(rels))
+    dt = time.time() - t0
+    entries = bl.load(os.path.join(repo, BASELINE_PATH))
+    new, _stale = bl.split(findings, entries)
+    assert new == [], [f.render() for f in new]
+    assert bl.hot_path_entries(entries) == []
+    assert dt < 60.0, f"jaxlint took {dt:.1f}s"
+
+
+# ---------------------------------------------------------------------------
+# runtime adjunct: retrace-budget harness
+# ---------------------------------------------------------------------------
+
+class CompileLog:
+    """Captures per-compilation log lines emitted under jax_log_compiles.
+    Each jit trace that reaches XLA logs 'Compiling <fn> ...' on the jax
+    logger; zero captured lines across a run means zero retraces."""
+
+    def __enter__(self):
+        import jax
+        self.messages = []
+        self._handler = logging.Handler()
+        self._handler.emit = \
+            lambda record: self.messages.append(record.getMessage())
+        self._logger = logging.getLogger("jax")
+        self._logger.addHandler(self._handler)
+        jax.config.update("jax_log_compiles", True)
+        return self
+
+    def __exit__(self, *exc):
+        import jax
+        jax.config.update("jax_log_compiles", False)
+        self._logger.removeHandler(self._handler)
+        return False
+
+    @property
+    def compiles(self):
+        return [m for m in self.messages if "ompiling" in m]
+
+
+def _plain_templates(k, cpu0=100):
+    from cluster_capacity_tpu.models.podspec import default_pod
+    return [default_pod(build_test_pod(f"t{i}", cpu0 * (i + 1), 1024 ** 3))
+            for i in range(k)]
+
+
+def test_retrace_budget_sweep():
+    """sweep over one static geometry compiles once: a second sweep with
+    different resource values but identical shapes adds zero compiles."""
+    from cluster_capacity_tpu import SchedulerProfile
+    from cluster_capacity_tpu.models.snapshot import ClusterSnapshot
+    from cluster_capacity_tpu.parallel.sweep import sweep
+
+    nodes = [build_test_node(f"n{i}", 8000, 32 * 1024 ** 3, 110)
+             for i in range(6)]
+    snapshot = ClusterSnapshot.from_objects(nodes)
+    profile = SchedulerProfile.parity()
+    sweep(snapshot, _plain_templates(4), profile=profile, max_limit=40)
+    with CompileLog() as log:
+        sweep(snapshot, _plain_templates(4, cpu0=150), profile=profile,
+              max_limit=40)
+    assert log.compiles == [], log.compiles
+
+
+def test_retrace_budget_fast_path_cache_bounded_and_quantized():
+    """_fast_batch_device is bounded at 64 entries and K is quantized:
+    snapshots whose max per-node capacity rounds to the same power of two
+    share one compiled kernel."""
+    from cluster_capacity_tpu import SchedulerProfile
+    from cluster_capacity_tpu.engine import encode as enc
+    from cluster_capacity_tpu.engine import fast_path
+    from cluster_capacity_tpu.models.podspec import default_pod
+    from cluster_capacity_tpu.models.snapshot import ClusterSnapshot
+
+    assert fast_path._fast_batch_device.cache_info().maxsize == 64
+
+    profile = SchedulerProfile.parity()
+
+    def solve(pod_slots):
+        nodes = [build_test_node(f"n{i}", 64000, 64 * 1024 ** 3, pod_slots)
+                 for i in range(5)]
+        snap = ClusterSnapshot.from_objects(nodes)
+        pb = enc.encode_problem(
+            snap, default_pod(build_test_pod("t", 100, 1024 ** 3)), profile)
+        return fast_path.solve_fast_batched([pb], max_limit=3)
+
+    r5 = solve(pod_slots=5)          # K=5 -> bucket 8
+    size_after_first = fast_path._fast_batch_device.cache_info().currsize
+    r7 = solve(pod_slots=7)          # K=7 -> same bucket 8
+    size_after_second = fast_path._fast_batch_device.cache_info().currsize
+    assert r5[0] is not None and r7[0] is not None
+    assert r5[0].placed_count == 3 and r7[0].placed_count == 3
+    assert size_after_second == size_after_first, \
+        "K quantization regressed: nearby capacities compiled separately"
+
+
+def test_retrace_budget_fused_compiled_call():
+    """The fused kernel's compile cache gains nothing on a second solve of
+    the same geometry (fused._compiled_call caches per packing/steps)."""
+    from cluster_capacity_tpu.engine import encode as enc
+    from cluster_capacity_tpu.engine import fused
+    from cluster_capacity_tpu.engine import simulator as sim
+    from cluster_capacity_tpu.models.podspec import default_pod
+    from cluster_capacity_tpu.models.snapshot import ClusterSnapshot
+    from cluster_capacity_tpu.utils.config import SchedulerProfile
+
+    nodes = [build_test_node(f"n{i}", 4000, 16 * 1024 ** 3, 16)
+             for i in range(16)]
+    snap = ClusterSnapshot.from_objects(nodes)
+    pb = enc.encode_problem(
+        snap, default_pod(build_test_pod("p", 700, 1024 ** 3)),
+        SchedulerProfile())
+    cfg = sim.static_config(pb)
+    os.environ["CC_TPU_FUSED"] = "1"
+    try:
+        assert fused.eligible(cfg, pb)
+        sim.solve(pb, max_limit=20, chunk_size=128)
+        size0 = fused._compiled_call.cache_info().currsize
+        with CompileLog() as log:
+            sim.solve(pb, max_limit=20, chunk_size=128)
+        assert fused._compiled_call.cache_info().currsize == size0
+        assert log.compiles == [], log.compiles
+    finally:
+        os.environ.pop("CC_TPU_FUSED", None)
+
+
+def test_retrace_budget_extenders():
+    """Regression pin for the hoisted extender kernels: the second
+    solve_with_extenders call must not retrace compute/apply."""
+    from cluster_capacity_tpu import SchedulerProfile
+    from cluster_capacity_tpu.engine import encode as enc
+    from cluster_capacity_tpu.engine.extenders import (ExtenderConfig,
+                                                       solve_with_extenders)
+    from cluster_capacity_tpu.models.podspec import default_pod
+    from cluster_capacity_tpu.models.snapshot import ClusterSnapshot
+
+    nodes = [build_test_node(f"n{i}", 2000, 8 * 1024 ** 3, 8)
+             for i in range(4)]
+    snap = ClusterSnapshot.from_objects(nodes)
+    profile = SchedulerProfile.parity()
+    ext = ExtenderConfig(
+        filter_callable=lambda pod, names: {"NodeNames": list(names)})
+
+    def pb(cpu):
+        return enc.encode_problem(
+            snap, default_pod(build_test_pod("p", cpu, 1024 ** 3)), profile)
+
+    solve_with_extenders(pb(100), [ext], max_limit=5)
+    with CompileLog() as log:
+        res = solve_with_extenders(pb(150), [ext], max_limit=5)
+    assert res.placed_count == 5
+    assert log.compiles == [], log.compiles

@@ -1,0 +1,1 @@
+"""Repo tooling namespace (lint gates, CI runner, jaxlint)."""

@@ -18,6 +18,21 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def _strip_comment(line: str) -> str:
+    """Drop a trailing YAML comment, but only at an unquoted `#` — a
+    `pytest -k "not slow # regression"` scalar must survive intact."""
+    quote = ""
+    for i, ch in enumerate(line):
+        if quote:
+            if ch == quote:
+                quote = ""
+        elif ch in ("'", '"'):
+            quote = ch
+        elif ch == "#":
+            return line[:i]
+    return line
+
+
 def _load_steps(path: str):
     """Minimal YAML subset reader for ci.yaml (no yaml dep needed in
     minimal images; falls back to PyYAML when present for robustness)."""
@@ -30,7 +45,7 @@ def _load_steps(path: str):
         pass
     steps, total, cur = [], 3600, None
     for raw in open(path):
-        line = raw.split("#", 1)[0].rstrip()
+        line = _strip_comment(raw).rstrip()
         if not line.strip():
             continue
         if line.startswith("timeout:") and cur is None:

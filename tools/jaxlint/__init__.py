@@ -1,0 +1,74 @@
+"""jaxlint: multi-pass AST static analysis for JAX/TPU antipatterns.
+
+Four passes over `cluster_capacity_tpu/` (see common.RULES for the rule
+registry): trace-safety, recompile-hazard, host-sync, dtype-discipline.
+Run via ``make lint`` or ``python -m tools.jaxlint``; tests drive single
+snippets through :func:`lint_source`.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence
+
+from . import (baseline, dtype_discipline, host_sync, recompile,
+               trace_safety)
+from .common import Finding, PASSES, RULES, apply_suppressions
+from .context import ModuleInfo, Program
+
+__all__ = ["Finding", "RULES", "PASSES", "lint_source", "lint_files",
+           "build_program", "run_passes", "baseline"]
+
+_PASS_RUNNERS = (
+    ("trace-safety", trace_safety.run),
+    ("recompile-hazard", recompile.run),
+    ("host-sync", host_sync.run),
+    ("dtype-discipline", dtype_discipline.run),
+)
+
+
+def module_key(relpath: str) -> str:
+    return relpath[:-3].replace("/", ".").replace("\\", ".")
+
+
+def build_program(sources: Sequence[tuple]) -> Program:
+    """sources: iterable of (repo-relative path, source text)."""
+    mods = [ModuleInfo(module_key(p), p, src) for p, src in sources]
+    return Program(mods)
+
+
+def run_passes(prog: Program,
+               only: Optional[Sequence[str]] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    for name, runner in _PASS_RUNNERS:
+        if only and name not in only:
+            continue
+        findings.extend(runner(prog))
+    by_path = {m.path: m.source for m in prog.modules}
+    findings = _suppress(findings, by_path)
+    return sorted(set(findings), key=lambda f: (f.path, f.line, f.rule))
+
+
+def _suppress(findings: List[Finding], by_path) -> List[Finding]:
+    out: List[Finding] = []
+    for path in sorted({f.path for f in findings}):
+        batch = [f for f in findings if f.path == path]
+        out.extend(apply_suppressions(batch, by_path[path]))
+    return out
+
+
+def lint_source(source: str, path: str = "cluster_capacity_tpu/_mem.py",
+                only: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Analyze one in-memory module (test entry point).  The default
+    synthetic path lands inside the scan root; point it under engine/ to
+    exercise the host-sync pass's hot-dir gating."""
+    return run_passes(build_program([(path, source)]), only=only)
+
+
+def lint_files(repo_root: str, relpaths: Sequence[str],
+               only: Optional[Sequence[str]] = None) -> List[Finding]:
+    sources = []
+    for rp in relpaths:
+        with open(os.path.join(repo_root, rp)) as f:
+            sources.append((rp.replace(os.sep, "/"), f.read()))
+    return run_passes(build_program(sources), only=only)

@@ -1,0 +1,106 @@
+"""jaxlint CLI: run the four passes over the tree and gate on the baseline.
+
+Usage::
+
+    python -m tools.jaxlint                  # lint cluster_capacity_tpu/
+    python -m tools.jaxlint path/dir ...     # lint specific roots
+    python -m tools.jaxlint --write-baseline # regenerate the baseline
+    python -m tools.jaxlint --list-rules
+
+Exit 0: no findings beyond the baseline and no baseline entries in the
+hot-path packages.  Exit 1: new findings or hot-path baseline entries.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+if __package__ in (None, ""):           # `python tools/jaxlint/__main__.py`
+    sys.path.insert(0, os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+    from tools.jaxlint import __main__ as _m   # re-enter as a package
+    sys.exit(_m.main())
+
+from . import baseline as bl
+from . import lint_files
+from .common import PASSES, RULES
+from .config import BASELINE_PATH, TARGET_DIRS
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _discover(roots) -> list:
+    rels = []
+    for root in roots:
+        ab = os.path.join(REPO, root)
+        if os.path.isfile(ab):
+            rels.append(os.path.relpath(ab, REPO))
+            continue
+        for dirpath, _dirnames, filenames in os.walk(ab):
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    rels.append(os.path.relpath(
+                        os.path.join(dirpath, fn), REPO))
+    return sorted(r.replace(os.sep, "/") for r in rels)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="jaxlint", description="JAX/TPU antipattern analysis")
+    ap.add_argument("roots", nargs="*", default=None,
+                    help=f"files/dirs to lint (default: {TARGET_DIRS})")
+    ap.add_argument("--pass", dest="passes", action="append",
+                    choices=PASSES, help="run only this pass (repeatable)")
+    ap.add_argument("--baseline", default=os.path.join(REPO, BASELINE_PATH))
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignore the baseline")
+    ap.add_argument("--write-baseline", action="store_true")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule, (pname, desc) in sorted(RULES.items()):
+            print(f"{rule}  [{pname}] {desc}")
+        return 0
+
+    t0 = time.time()
+    rels = _discover(args.roots or list(TARGET_DIRS))
+    findings = lint_files(REPO, rels, only=args.passes)
+
+    if args.write_baseline:
+        bl.save(args.baseline, findings)
+        print(f"jaxlint: wrote {len(findings)} finding(s) to "
+              f"{os.path.relpath(args.baseline, REPO)}")
+        return 0
+
+    entries = [] if args.no_baseline else bl.load(args.baseline)
+    new, stale = bl.split(findings, entries)
+    hot = bl.hot_path_entries(entries)
+
+    for f in new:
+        print(f.render())
+    for key in stale:
+        print(f"jaxlint: warning: stale baseline entry {key[0]}: "
+              f"{key[1]} (fixed? run --write-baseline)", file=sys.stderr)
+    rc = 0
+    if hot:
+        for e in hot:
+            print(f"jaxlint: error: baseline suppression in hot path: "
+                  f"{e['path']}: {e['rule']} — fix it, don't baseline it",
+                  file=sys.stderr)
+        rc = 1
+    if new:
+        rc = 1
+    dt = time.time() - t0
+    print(f"jaxlint: {len(rels)} files, {len(findings)} finding(s) "
+          f"({len(new)} new, {len(findings) - len(new)} baselined) "
+          f"in {dt:.1f}s")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

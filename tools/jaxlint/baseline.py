@@ -1,0 +1,64 @@
+"""Baseline file: grandfathered findings the gate tolerates.
+
+The baseline is a JSON list of ``{"path", "rule", "message"}`` entries —
+line numbers are deliberately omitted so findings survive unrelated edits
+above them.  New findings (not in the baseline) fail the gate; stale
+entries (in the baseline but no longer found) are warnings nudging a
+cleanup.  Entries under the hot-path packages are a hard error: the
+ISSUE-2 contract is that engine//parallel//ops real findings get *fixed*,
+not baselined.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterable, List, Set, Tuple
+
+from .common import Finding
+from .config import HOT_DIR_PREFIXES
+
+Key = Tuple[str, str, str]
+
+
+def load(path: str) -> List[dict]:
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        data = json.load(f)
+    return list(data.get("findings", []))
+
+
+def save(path: str, findings: Iterable[Finding]) -> None:
+    entries = sorted(
+        {(f.path, f.rule, f.message) for f in findings})
+    doc = {
+        "comment": "jaxlint baseline: grandfathered findings. Entries "
+                   "under engine//parallel//ops fail the gate — fix "
+                   "those, don't baseline them. Regenerate with "
+                   "`python -m tools.jaxlint --write-baseline`.",
+        "findings": [{"path": p, "rule": r, "message": m}
+                     for p, r, m in entries],
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def keys(entries: Iterable[dict]) -> Set[Key]:
+    return {(e["path"], e["rule"], e["message"]) for e in entries}
+
+
+def hot_path_entries(entries: Iterable[dict]) -> List[dict]:
+    return [e for e in entries
+            if any(e["path"].startswith(p) for p in HOT_DIR_PREFIXES)]
+
+
+def split(findings: List[Finding], entries: List[dict]
+          ) -> Tuple[List[Finding], List[Key]]:
+    """(new findings not covered by the baseline, stale baseline keys)."""
+    known = keys(entries)
+    found = {f.key() for f in findings}
+    new = [f for f in findings if f.key() not in known]
+    stale = sorted(known - found)
+    return new, stale

@@ -1,0 +1,107 @@
+"""Shared jaxlint data model: findings, rule registry, suppressions.
+
+A finding is one (rule, file, line) triple with a human message.  Rules are
+registered here so `--list-rules` and the doc table (doc/architecture.md)
+stay in sync with the passes that implement them.
+
+Inline suppressions:
+  ``# jaxlint: disable=<rule>[,<rule>...]``      suppress on this line
+  ``# jaxlint: disable``                          suppress every rule here
+  ``# jaxlint: disable-file=<rule>[,...]``        suppress for the whole file
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Set, Tuple
+
+_DISABLE_RE = re.compile(r"#\s*jaxlint:\s*disable(-file)?(?:=([\w\-, ]+))?")
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str          # repo-relative, forward slashes
+    line: int          # 1-based
+    rule: str          # e.g. "TS001"
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+    def key(self) -> Tuple[str, str, str]:
+        """Line-number-independent identity used by the baseline: findings
+        survive unrelated edits above them."""
+        return (self.path, self.rule, self.message)
+
+
+# rule id -> (pass name, one-line description).  The doc table in
+# doc/architecture.md mirrors this registry.
+RULES: Dict[str, Tuple[str, str]] = {
+    "TS001": ("trace-safety",
+              "Python control flow (if/while/for/ternary) on a value derived "
+              "from traced arguments inside a jit/pallas-traced function"),
+    "TS002": ("trace-safety",
+              "bool()/int()/float() concretization of a traced value"),
+    "TS003": ("trace-safety",
+              ".item()/.tolist()/np.asarray() host materialization of a "
+              "traced value inside a traced function"),
+    "RC001": ("recompile-hazard",
+              "jax.jit/pallas_call created per call inside an uncached "
+              "function; every call retraces and recompiles"),
+    "RC002": ("recompile-hazard",
+              "unbounded lru_cache(maxsize=None) around a jit factory with "
+              "parameters; compile cache grows without bound"),
+    "RC003": ("recompile-hazard",
+              "unhashable or array-valued argument passed in a static "
+              "position of a jitted callable"),
+    "RC004": ("recompile-hazard",
+              "jitted closure captures an array built in the enclosing "
+              "per-call scope; a fresh array object forces a retrace"),
+    "HS001": ("host-sync",
+              ".block_until_ready() outside a whitelisted sync point"),
+    "HS002": ("host-sync",
+              "jax.device_get outside a whitelisted sync point"),
+    "HS003": ("host-sync",
+              "host materialization (np.asarray/.item/.tolist) of a device "
+              "value inside a loop outside a whitelisted sync point"),
+    "DT001": ("dtype-discipline",
+              "builtin float/int used as a dtype; width follows platform or "
+              "the x64 flag — spell np.float64/np.int64/jnp.int32 explicitly"),
+    "DT002": ("dtype-discipline",
+              "int32 jnp reduction (sum/cumsum/prod) without an explicit "
+              "accumulator dtype; capacity math can overflow 2**31"),
+}
+
+PASSES = ("trace-safety", "recompile-hazard", "host-sync", "dtype-discipline")
+
+
+def parse_suppressions(source: str) -> Tuple[Dict[int, Set[str]], Set[str]]:
+    """(line -> suppressed rules, file-wide suppressed rules).  The empty-set
+    sentinel ``{"*"}`` means every rule."""
+    per_line: Dict[int, Set[str]] = {}
+    per_file: Set[str] = set()
+    for i, line in enumerate(source.splitlines(), 1):
+        m = _DISABLE_RE.search(line)
+        if not m:
+            continue
+        rules = ({"*"} if not m.group(2) else
+                 {r.strip().upper() for r in m.group(2).split(",") if r.strip()})
+        if m.group(1):        # disable-file
+            per_file |= rules
+        else:
+            per_line.setdefault(i, set()).update(rules)
+    return per_line, per_file
+
+
+def apply_suppressions(findings: List[Finding], source: str) -> List[Finding]:
+    per_line, per_file = parse_suppressions(source)
+    out = []
+    for f in findings:
+        if "*" in per_file or f.rule in per_file:
+            continue
+        sup = per_line.get(f.line, ())
+        if "*" in sup or f.rule in sup:
+            continue
+        out.append(f)
+    return out

@@ -1,0 +1,51 @@
+"""jaxlint configuration: scan roots, hot-path dirs, designated sync points.
+
+The host-sync pass only patrols the hot-path packages — code that runs per
+pod per sweep point.  CLI / reporting layers are allowed to materialize
+device values freely.  Within the hot path, the functions named in
+SYNC_QUALNAMES are the *designated* device→host boundaries (the solver
+drivers that collect final results); syncs anywhere else are findings.
+"""
+
+from __future__ import annotations
+
+# Default scan root, relative to the repo root.
+TARGET_DIRS = ("cluster_capacity_tpu",)
+
+# Packages where host syncs are policed (repo-relative path prefixes).
+HOT_DIR_PREFIXES = (
+    "cluster_capacity_tpu/engine/",
+    "cluster_capacity_tpu/parallel/",
+    "cluster_capacity_tpu/ops/",
+)
+
+# Function qualnames allowed to synchronize with the device.  A sync call
+# lexically inside any of these (or inside a function they nest) is fine:
+# these are the documented collect points where the solver loop has already
+# finished and results must come back to the host anyway.
+SYNC_QUALNAMES = {
+    # engine/simulator.py: end-of-solve readback + multi-host replication
+    "solve",
+    "_solve_capacity",
+    # engine/fast_path.py: analytic path returns host-side placements
+    "solve_fast",
+    "solve_fast_batched",
+    "_fast_batch_chunk",
+    # engine/extenders.py: extender loop alternates host filtering rounds
+    "solve_with_extenders",
+    # engine/fused*.py: runner collect paths unpack kernel outputs
+    "collect",
+    "_collect",
+    "to_result",
+    "_unpack_result",
+    "call_and_unpack",
+    # parallel/sweep.py + interleave.py: batched drivers' final readbacks
+    "_batched_solve",
+    "sweep",
+    "solve_interleaved",
+    "solve_interleaved_tensor",
+    "_drain",
+}
+
+# Default baseline location, relative to the repo root.
+BASELINE_PATH = "tools/jaxlint_baseline.json"

@@ -1,0 +1,356 @@
+"""Analysis context: per-module ASTs, import-alias resolution, and the
+cross-module traced-function registry.
+
+The trace-safety pass needs to know which functions JAX traces.  Seeds are
+discovered syntactically (jit/pjit decorators and wrappers, pallas_call
+kernels, lax.scan/while/cond/vmap bodies); reachability then propagates
+through ordinary calls: a function invoked from a traced body with a
+traced-value argument is itself traced for that parameter.  Static
+arguments (``static_argnums``/``static_argnames``) start untainted, so
+branching on a StaticConfig inside the scan step is — correctly — clean.
+
+Resolution is name-based and intra-repository: ``from ..engine import
+simulator as sim`` followed by ``sim._step(...)`` resolves to the `_step`
+FuncInfo of the simulator module, so taint crosses module boundaries the
+same way calls do.  Method calls on objects (``self.x()``, ``runner.y()``)
+are not resolved — the analysis stays conservative rather than guessing.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+JIT_NAMES = {"jax.jit", "jax.pjit", "jax.experimental.pjit.pjit"}
+PALLAS_CALL = {"jax.experimental.pallas.pallas_call"}
+# Transforms whose callable arguments JAX traces (all params traced).
+TRACING_TRANSFORMS = {
+    "jax.vmap", "jax.pmap", "jax.grad", "jax.value_and_grad",
+    "jax.checkpoint", "jax.remat", "jax.custom_jvp", "jax.custom_vjp",
+    "jax.lax.scan", "jax.lax.while_loop", "jax.lax.fori_loop",
+    "jax.lax.cond", "jax.lax.switch", "jax.lax.map",
+    "jax.lax.associative_scan",
+}
+CACHE_DECORATORS = {"functools.lru_cache", "functools.cache"}
+
+# Attribute reads that yield static (host) values even on tracers.
+STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "weak_type", "sharding",
+                "itemsize", "nbytes"}
+# Builtins whose results are host values regardless of argument taint.
+UNTAINTING_CALLS = {"len", "isinstance", "type", "hasattr", "callable",
+                    "id", "repr", "str", "getattr", "issubclass"}
+
+
+def params_of(fn: ast.AST) -> List[str]:
+    a = fn.args
+    names = [p.arg for p in getattr(a, "posonlyargs", [])]
+    names += [p.arg for p in a.args]
+    names += [p.arg for p in a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+class FuncInfo:
+    """One function/lambda definition and its trace state."""
+
+    def __init__(self, module: "ModuleInfo", qualname: str, node: ast.AST):
+        self.module = module
+        self.qualname = qualname
+        self.node = node
+        self.params = params_of(node)
+        self.static: Set[str] = set()       # jit-static params
+        self.traced = False                 # reachable from a trace entry
+        self.tainted: Set[str] = set()      # traced-value params
+        self.jit_site: Optional[ast.AST] = None
+        self.nested = False                 # defined inside another function
+        self.is_factory = False             # returns a jitted callable
+        self.factory_static: Set[str] = set()
+
+    @property
+    def ref(self) -> str:
+        return f"mod:{self.module.key}.{self.qualname}"
+
+    def seed(self, static: Set[str]) -> None:
+        """Mark as a trace entry: every non-static param is traced."""
+        self.traced = True
+        self.static |= static
+        self.tainted |= {p for p in self.params if p not in self.static}
+
+
+class ModuleInfo:
+    def __init__(self, key: str, path: str, source: str):
+        self.key = key                      # dotted module name
+        self.path = path                    # repo-relative path
+        self.source = source
+        self.tree = ast.parse(source)
+        self.alias: Dict[str, str] = {}     # local name -> dotted root
+        self.funcs: Dict[str, FuncInfo] = {}        # qualname -> info
+        self.by_name: Dict[str, List[FuncInfo]] = {}  # bare name -> infos
+        self.func_by_node: Dict[ast.AST, FuncInfo] = {}
+        self._collect_aliases()
+        self._collect_funcs()
+        self._annotate_parents()
+
+    # -- imports ----------------------------------------------------------
+    def _collect_aliases(self) -> None:
+        pkg_parts = self.key.split(".")[:-1]        # containing package
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for al in node.names:
+                    self.alias[al.asname or al.name.split(".")[0]] = (
+                        al.name if al.asname else al.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    base = pkg_parts[:len(pkg_parts) - (node.level - 1)]
+                    root = ".".join(base + ([node.module] if node.module
+                                            else []))
+                    prefix = f"mod:{root}" if root else "mod:"
+                else:
+                    root = node.module or ""
+                    prefix = root
+                for al in node.names:
+                    if al.name == "*":
+                        continue
+                    tgt = f"{prefix}.{al.name}" if prefix else al.name
+                    self.alias[al.asname or al.name] = tgt
+
+    # -- function registry ------------------------------------------------
+    def _collect_funcs(self) -> None:
+        def visit(node: ast.AST, prefix: str, depth: int) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    q = f"{prefix}{child.name}"
+                    fi = FuncInfo(self, q, child)
+                    fi.nested = depth > 0
+                    self.funcs[q] = fi
+                    self.by_name.setdefault(child.name, []).append(fi)
+                    self.func_by_node[child] = fi
+                    visit(child, f"{q}.<locals>.", depth + 1)
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, f"{prefix}{child.name}.", depth)
+                else:
+                    visit(child, prefix, depth)
+        visit(self.tree, "", 0)
+
+    def _annotate_parents(self) -> None:
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                child._jl_parent = node  # type: ignore[attr-defined]
+
+    # -- name resolution --------------------------------------------------
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Dotted canonical name for an expression, or None.  Local
+        module-level functions resolve to their ``mod:`` ref."""
+        if isinstance(node, ast.Name):
+            if node.id in self.funcs and not self.funcs[node.id].nested:
+                return self.funcs[node.id].ref
+            return self.alias.get(node.id)
+        if isinstance(node, ast.Attribute):
+            base = self.resolve(node.value)
+            if base is None:
+                return None
+            return f"{base}.{node.attr}"
+        if isinstance(node, ast.Call):
+            return None
+        return None
+
+    def enclosing_functions(self, node: ast.AST) -> List[ast.AST]:
+        """Chain of FunctionDefs lexically containing `node`, innermost
+        first (requires _annotate_parents)."""
+        out = []
+        cur = getattr(node, "_jl_parent", None)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                out.append(cur)
+            cur = getattr(cur, "_jl_parent", None)
+        return out
+
+
+class Program:
+    """All modules under analysis plus the cross-module registry."""
+
+    def __init__(self, modules: Sequence[ModuleInfo]):
+        self.modules = list(modules)
+        self.registry: Dict[str, FuncInfo] = {}
+        for m in self.modules:
+            for fi in m.funcs.values():
+                self.registry[fi.ref] = fi
+        self.lambda_info: Dict[ast.Lambda, FuncInfo] = {}
+        for m in self.modules:
+            discover_jit(m, self)
+            discover_factories(m, self)
+
+    def lookup(self, ref: Optional[str]) -> Optional[FuncInfo]:
+        if ref is None:
+            return None
+        if not ref.startswith("mod:"):
+            ref = f"mod:{ref}"          # absolute-import spelling
+        return self.registry.get(ref)
+
+
+def _resolve_is(mod: ModuleInfo, node: ast.AST, names: Set[str]) -> bool:
+    r = mod.resolve(node)
+    return r is not None and r in names
+
+
+def is_jit_expr(mod: ModuleInfo, node: ast.AST) -> bool:
+    return _resolve_is(mod, node, JIT_NAMES)
+
+
+def is_pallas_expr(mod: ModuleInfo, node: ast.AST) -> bool:
+    r = mod.resolve(node)
+    return r is not None and (r in PALLAS_CALL or r.endswith(".pallas_call"))
+
+
+def jit_statics(mod: ModuleInfo, call: ast.Call,
+                params: List[str]) -> Set[str]:
+    """static_argnames/static_argnums of a jit(...) or partial(jax.jit, ...)
+    call, as parameter names."""
+    static: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for el in ast.walk(kw.value):
+                if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                    static.add(el.value)
+        elif kw.arg == "static_argnums":
+            for el in ast.walk(kw.value):
+                if isinstance(el, ast.Constant) and isinstance(el.value, int):
+                    if 0 <= el.value < len(params):
+                        static.add(params[el.value])
+    return static
+
+
+def _local_func(mod: ModuleInfo, node: ast.AST) -> Optional[FuncInfo]:
+    if isinstance(node, ast.Name):
+        cands = mod.by_name.get(node.id)
+        if cands:
+            return cands[-1]
+    return None
+
+
+def _func_for_arg(mod: ModuleInfo, prog: Program,
+                  node: ast.AST) -> Optional[FuncInfo]:
+    if isinstance(node, ast.Lambda):
+        fi = prog.lambda_info.get(node)
+        if fi is None:
+            fi = FuncInfo(mod, f"<lambda:{node.lineno}>", node)
+            fi.nested = True
+            prog.lambda_info[node] = fi
+        return fi
+    fi = _local_func(mod, node)
+    if fi is not None:
+        return fi
+    return prog.lookup(mod.resolve(node))
+
+
+def discover_jit(mod: ModuleInfo, prog: Program) -> None:
+    """Seed traced functions from jit/pallas/transform syntax."""
+    # decorators
+    for fi in mod.funcs.values():
+        for dec in getattr(fi.node, "decorator_list", []):
+            if is_jit_expr(mod, dec):
+                fi.seed(set())
+                fi.jit_site = dec
+            elif isinstance(dec, ast.Call):
+                if is_jit_expr(mod, dec.func):
+                    fi.seed(jit_statics(mod, dec, fi.params))
+                    fi.jit_site = dec
+                elif (mod.resolve(dec.func) == "functools.partial"
+                        and dec.args and is_jit_expr(mod, dec.args[0])):
+                    fi.seed(jit_statics(mod, dec, fi.params))
+                    fi.jit_site = dec
+    # wrapper calls and tracing transforms
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = mod.resolve(node.func)
+        if callee in JIT_NAMES and node.args:
+            fi = _func_for_arg(mod, prog, node.args[0])
+            if fi is not None:
+                fi.seed(jit_statics(mod, node, fi.params))
+                fi.jit_site = fi.jit_site or node
+        elif (callee == "functools.partial" and len(node.args) >= 2
+                and is_jit_expr(mod, node.args[0])):
+            fi = _func_for_arg(mod, prog, node.args[1])
+            if fi is not None:
+                fi.seed(jit_statics(mod, node, fi.params))
+                fi.jit_site = fi.jit_site or node
+        elif callee is not None and (callee in TRACING_TRANSFORMS
+                                     or callee.endswith(".pallas_call")):
+            for arg in node.args:
+                fi = _func_for_arg(mod, prog, arg)
+                if fi is not None:
+                    fi.seed(set())
+
+
+def discover_factories(mod: ModuleInfo, prog: Program) -> None:
+    """Functions returning a jitted callable: their call results dispatch
+    traced code (used by host-sync device tainting and RC003)."""
+    for fi in mod.funcs.values():
+        if fi.traced:
+            continue
+        # names bound to a jit(...) call result within this function body
+        jit_names: Set[str] = set()
+        for node in ast.walk(fi.node):
+            if (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)
+                    and is_jit_expr(mod, node.value.func)):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        jit_names.add(t.id)
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Return) or node.value is None:
+                continue
+            # skip returns belonging to nested defs
+            encl = mod.enclosing_functions(node)
+            if encl and encl[0] is not fi.node:
+                continue
+            val = node.value
+            if isinstance(val, ast.Call) and is_jit_expr(mod, val.func):
+                fi.is_factory = True
+                fi.factory_static = jit_statics(mod, val, [])
+            elif isinstance(val, ast.Name):
+                if val.id in jit_names:
+                    fi.is_factory = True
+                    continue
+                target = _local_func(mod, val)
+                if target is not None and target.traced and \
+                        target.jit_site is not None:
+                    fi.is_factory = True
+                    fi.factory_static = set(target.static)
+            elif isinstance(val, ast.Tuple):
+                for el in val.elts:
+                    if isinstance(el, ast.Name):
+                        t = _local_func(mod, el)
+                        if (el.id in jit_names or (
+                                t is not None and t.traced
+                                and t.jit_site is not None)):
+                            fi.is_factory = True
+
+
+def has_cache_decorator(mod: ModuleInfo, fn: ast.AST) -> bool:
+    for dec in getattr(fn, "decorator_list", []):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        r = mod.resolve(target)
+        if r in CACHE_DECORATORS or (r or "").endswith("lru_cache") \
+                or (r or "").endswith(".cache"):
+            return True
+    return False
+
+
+def enclosing_uncached(mod: ModuleInfo, node: ast.AST) -> Optional[ast.AST]:
+    """Innermost real FunctionDef containing `node` when NO function in the
+    lexical chain carries a caching decorator; None otherwise (module level
+    or cached factory scope)."""
+    chain = [f for f in mod.enclosing_functions(node)
+             if isinstance(f, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    if not chain:
+        return None
+    for f in chain:
+        if has_cache_decorator(mod, f):
+            return None
+    return chain[0]

@@ -1,0 +1,80 @@
+"""dtype-discipline pass: width-ambiguous dtypes and int32 overflow.
+
+Builtin ``float``/``int`` as a dtype means "whatever the platform and the
+x64 flag say" — this tree flips ``jax_enable_x64`` at runtime
+(`_ensure_x64`), so the same line can yield f32 or f64 depending on import
+order.  Capacity math also runs cumulative sums over pod counts where an
+int32 accumulator overflows at 2**31 for large synthetic sweeps.
+
+Rules: DT001 (builtin float/int as dtype or .astype argument), DT002
+(jnp integer reduction over an int32-cast operand without an explicit
+accumulator dtype).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .common import Finding
+from .context import ModuleInfo, Program
+
+REDUCTIONS = {"sum", "cumsum", "prod", "cumprod"}
+NARROW_INTS = {"int32", "int16", "int8", "uint32", "uint16", "uint8"}
+
+
+def _is_builtin_num(node: ast.AST) -> str:
+    if isinstance(node, ast.Name) and node.id in ("float", "int"):
+        return node.id
+    return ""
+
+
+def run(prog: Program) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in prog.modules:
+        _check_module(mod, findings)
+    return findings
+
+
+def _check_module(mod: ModuleInfo, findings: List[Finding]) -> None:
+    path = mod.path
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        # DT001: dtype=float / dtype=int keywords
+        for kw in node.keywords:
+            if kw.arg == "dtype":
+                b = _is_builtin_num(kw.value)
+                if b:
+                    findings.append(Finding(
+                        path, node.lineno, "DT001",
+                        f"dtype={b} resolves per platform/x64 flag; spell "
+                        f"the width (np.{b}64 / jnp.{b}32) explicitly"))
+        # DT001: .astype(float) / .astype(int)
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "astype" and node.args:
+            b = _is_builtin_num(node.args[0])
+            if b:
+                findings.append(Finding(
+                    path, node.lineno, "DT001",
+                    f".astype({b}) resolves per platform/x64 flag; spell "
+                    f"the width (np.{b}64 / jnp.{b}32) explicitly"))
+        # DT002: jnp.<reduction>(x.astype(jnp.int32)) with no dtype=
+        r = mod.resolve(node.func)
+        if r is None or not r.startswith("jax.numpy."):
+            continue
+        if r.rsplit(".", 1)[1] not in REDUCTIONS:
+            continue
+        if any(kw.arg == "dtype" for kw in node.keywords):
+            continue
+        for a in node.args:
+            if isinstance(a, ast.Call) and \
+                    isinstance(a.func, ast.Attribute) and \
+                    a.func.attr == "astype" and a.args and \
+                    isinstance(a.args[0], ast.Attribute) and \
+                    a.args[0].attr in NARROW_INTS:
+                findings.append(Finding(
+                    path, node.lineno, "DT002",
+                    f"{r.rsplit('.', 1)[1]} over an {a.args[0].attr} "
+                    "operand accumulates in the narrow type; pass "
+                    "dtype=jnp.int64 (or justify the bound and suppress)"))

@@ -1,0 +1,150 @@
+"""host-sync pass: device→host round-trips in the solver hot path.
+
+Every `.block_until_ready()`, `jax.device_get`, or numpy materialization
+of a device array stalls the dispatch pipeline; inside a loop it turns an
+asynchronous sweep into a lock-step one.  The hot-path packages (engine/,
+parallel/, ops/) are supposed to stay fully asynchronous except at the
+designated collect points listed in config.SYNC_QUALNAMES, where the
+caller genuinely needs host values.
+
+Device taint here is deliberately shallow: a name is "device-valued" when
+it is assigned directly from a jnp./jax. call or from a call to a known
+jitted function or jit-factory product.  Host-side numpy bookkeeping —
+which the drivers do plenty of — never trips the pass.
+
+Rules: HS001 (block_until_ready), HS002 (jax.device_get), HS003
+(np.asarray/.item()/.tolist() of a device value inside a loop).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from .common import Finding
+from .config import HOT_DIR_PREFIXES, SYNC_QUALNAMES
+from .context import ModuleInfo, Program
+
+HOST_PULLS = {"item", "tolist"}
+
+
+def _in_hot_path(path: str) -> bool:
+    return any(path.startswith(p) for p in HOT_DIR_PREFIXES)
+
+
+def _whitelisted(mod: ModuleInfo, node: ast.AST) -> bool:
+    for f in mod.enclosing_functions(node):
+        if getattr(f, "name", None) in SYNC_QUALNAMES:
+            return True
+    return False
+
+
+def _device_names(mod: ModuleInfo, prog: Program, fn: ast.AST) -> Set[str]:
+    """Names assigned from device-producing calls within `fn`."""
+    out: Set[str] = set()
+    for n in ast.walk(fn):
+        if not isinstance(n, ast.Assign) or not isinstance(n.value,
+                                                           ast.Call):
+            continue
+        call = n.value
+        r = mod.resolve(call.func)
+        device = False
+        if r is not None and (r.startswith("jax.numpy.")
+                              or r.startswith("jax.lax.")
+                              or r == "jax.device_put"):
+            device = True
+        else:
+            callee = prog.lookup(r)
+            if callee is None and isinstance(call.func, ast.Name):
+                callee = mod.funcs.get(call.func.id)
+            if callee is not None and (callee.jit_site is not None
+                                       or callee.traced):
+                device = True
+            # product of a jit factory: runner = _runner(...); runner(...)
+            if callee is not None and callee.is_factory:
+                device = True
+        if device:
+            for t in n.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+                elif isinstance(t, (ast.Tuple, ast.List)):
+                    for el in t.elts:
+                        if isinstance(el, ast.Name):
+                            out.add(el.id)
+    return out
+
+
+def _loops(fn: ast.AST):
+    for n in ast.walk(fn):
+        if isinstance(n, (ast.For, ast.While)):
+            yield n
+
+
+def run(prog: Program) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in prog.modules:
+        if not _in_hot_path(mod.path):
+            continue
+        _check_module(mod, prog, findings)
+    return findings
+
+
+def _check_module(mod: ModuleInfo, prog: Program,
+                  findings: List[Finding]) -> None:
+    path = mod.path
+    # HS001 / HS002: anywhere in a hot-path module outside sync points.
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "block_until_ready":
+            if not _whitelisted(mod, node):
+                findings.append(Finding(
+                    path, node.lineno, "HS001",
+                    ".block_until_ready() stalls dispatch outside a "
+                    "designated sync point; let the collect path "
+                    "synchronize"))
+        r = mod.resolve(node.func)
+        if r == "jax.device_get" and not _whitelisted(mod, node):
+            findings.append(Finding(
+                path, node.lineno, "HS002",
+                "jax.device_get outside a designated sync point forces a "
+                "device round-trip; defer to the collect path"))
+
+    # HS003: loop-carried host pulls of device values, per function.
+    for fi in mod.funcs.values():
+        if fi.nested or fi.traced:
+            continue        # traced bodies are trace-safety's turf
+        if any(f is not fi.node
+               for f in (mod.enclosing_functions(fi.node) or [fi.node])
+               if getattr(f, "name", None) in SYNC_QUALNAMES) or \
+                fi.node.name in SYNC_QUALNAMES:
+            continue
+        dev = _device_names(mod, prog, fi.node)
+        if not dev:
+            continue
+        for loop in _loops(fi.node):
+            for n in ast.walk(loop):
+                if not isinstance(n, ast.Call):
+                    continue
+                if isinstance(n.func, ast.Attribute) and \
+                        n.func.attr in HOST_PULLS and \
+                        isinstance(n.func.value, ast.Name) and \
+                        n.func.value.id in dev:
+                    findings.append(Finding(
+                        path, n.lineno, "HS003",
+                        f".{n.func.attr}() on device value "
+                        f"`{n.func.value.id}` inside a loop in "
+                        f"`{fi.qualname}` serializes the sweep; batch the "
+                        "readback after the loop"))
+                else:
+                    r = mod.resolve(n.func)
+                    if r in ("numpy.asarray", "numpy.array") and n.args \
+                            and isinstance(n.args[0], ast.Name) and \
+                            n.args[0].id in dev:
+                        findings.append(Finding(
+                            path, n.lineno, "HS003",
+                            f"np.asarray on device value `{n.args[0].id}` "
+                            f"inside a loop in `{fi.qualname}` forces a "
+                            "sync per iteration; collect once after the "
+                            "loop"))

@@ -1,0 +1,222 @@
+"""recompile-hazard pass: patterns that defeat jit's compilation cache.
+
+jit caches on (function object, abstract shapes, static values).  Anything
+that mints a fresh function object per call — a jit() created inside an
+uncached function, a nested jitted def — recompiles every time.  Anything
+that widens the static key — arrays or unhashables in static positions,
+closures over per-call arrays — either throws at dispatch or retraces on
+every new object.  And an lru_cache(maxsize=None) wrapped around a jit
+factory keyed on snapshot-varying values leaks compiled executables for
+the life of the process.
+
+The blessed idiom in this tree is the cached factory::
+
+    @functools.lru_cache(maxsize=<bounded>)
+    def _runner(static_geometry):
+        @partial(jax.jit, static_argnames=(...))
+        def run(...): ...
+        return run
+
+Rules: RC001 (jit/pallas_call created per call), RC002 (unbounded cache
+around a parametrised jit factory), RC003 (unhashable/array static
+argument), RC004 (jitted closure over a per-call array).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from .common import Finding
+from .context import (FuncInfo, ModuleInfo, Program, enclosing_uncached,
+                      has_cache_decorator, is_jit_expr, is_pallas_expr)
+
+ARRAY_CTORS = {"array", "asarray", "zeros", "ones", "full", "arange",
+               "linspace", "empty", "eye", "stack", "concatenate",
+               "broadcast_to"}
+
+
+def _array_ctor_call(mod: ModuleInfo, node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    r = mod.resolve(node.func)
+    if r is None:
+        return False
+    head, _, tail = r.rpartition(".")
+    return tail in ARRAY_CTORS and (
+        head in ("numpy", "jax.numpy") or head.endswith(".numpy"))
+
+
+def _enclosing_info(mod: ModuleInfo, node: ast.AST) -> Optional[FuncInfo]:
+    for f in mod.enclosing_functions(node):
+        fi = mod.func_by_node.get(f)
+        if fi is not None:
+            return fi
+    return None
+
+
+def _maxsize_is_none(dec: ast.AST, mod: ModuleInfo) -> bool:
+    """True for @lru_cache(maxsize=None), @lru_cache(None), bare
+    @functools.cache (unbounded by definition)."""
+    target = dec.func if isinstance(dec, ast.Call) else dec
+    r = mod.resolve(target) or ""
+    if not isinstance(dec, ast.Call):
+        return r.endswith(".cache") or r == "functools.cache"
+    if not (r.endswith("lru_cache") or r.endswith(".cache")):
+        return False
+    if r.endswith(".cache"):
+        return True
+    for kw in dec.keywords:
+        if kw.arg == "maxsize":
+            return isinstance(kw.value, ast.Constant) and \
+                kw.value.value is None
+    if dec.args:
+        return isinstance(dec.args[0], ast.Constant) and \
+            dec.args[0].value is None
+    return False       # lru_cache() defaults to maxsize=128 -> bounded
+
+
+def _creates_jit(mod: ModuleInfo, fi: FuncInfo) -> bool:
+    for node in ast.walk(fi.node):
+        if isinstance(node, ast.Call) and (is_jit_expr(mod, node.func)
+                                           or is_pallas_expr(mod, node.func)):
+            return True
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
+                node is not fi.node:
+            sub = mod.func_by_node.get(node)
+            if sub is not None and sub.jit_site is not None:
+                return True
+    return False
+
+
+def _free_loads(fn: ast.AST) -> Set[str]:
+    params = {p.arg for p in fn.args.args + fn.args.kwonlyargs
+              + getattr(fn.args, "posonlyargs", [])}
+    if fn.args.vararg:
+        params.add(fn.args.vararg.arg)
+    if fn.args.kwarg:
+        params.add(fn.args.kwarg.arg)
+    stored = set()
+    loaded = set()
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Name):
+            if isinstance(n.ctx, ast.Store):
+                stored.add(n.id)
+            else:
+                loaded.add(n.id)
+    return loaded - params - stored
+
+
+def run(prog: Program) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in prog.modules:
+        _check_module(mod, prog, findings)
+    return findings
+
+
+def _check_module(mod: ModuleInfo, prog: Program,
+                  findings: List[Finding]) -> None:
+    path = mod.path
+
+    # RC001 via decorated nested defs; RC004 for their array captures
+    for fi in mod.funcs.values():
+        if fi.jit_site is None or not fi.nested:
+            continue
+        if enclosing_uncached(mod, fi.node) is None:
+            continue
+        parent = _enclosing_info(mod, fi.node)
+        if parent is not None and parent.is_factory:
+            continue        # returned to the caller: caching is theirs
+        findings.append(Finding(
+            path, fi.node.lineno, "RC001",
+            f"jitted `{fi.node.name if hasattr(fi.node, 'name') else '<lambda>'}`"
+            " is defined per call of "
+            f"`{parent.qualname if parent else '?'}`; every call retraces — "
+            "hoist it into a cached factory (see engine/simulator.py "
+            "`_chunk_runner`)"))
+        if parent is not None:
+            captured = _free_loads(fi.node)
+            for n in ast.walk(parent.node):
+                if isinstance(n, ast.Assign) and \
+                        _array_ctor_call(mod, n.value):
+                    for t in n.targets:
+                        if isinstance(t, ast.Name) and t.id in captured:
+                            findings.append(Finding(
+                                path, fi.node.lineno, "RC004",
+                                f"jitted closure captures array `{t.id}` "
+                                "built per call in "
+                                f"`{parent.qualname}`; a fresh array object"
+                                " is a new trace key"))
+
+    # RC001 via direct jit(...)/pallas_call(...) call sites
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not (is_jit_expr(mod, node.func) or is_pallas_expr(mod,
+                                                              node.func)):
+            continue
+        if enclosing_uncached(mod, node) is None:
+            continue
+        parent = _enclosing_info(mod, node)
+        if parent is not None and parent.is_factory:
+            continue
+        name = "pallas_call" if is_pallas_expr(mod, node.func) else "jax.jit"
+        findings.append(Finding(
+            path, node.lineno, "RC001",
+            f"{name}(...) built inside `{parent.qualname if parent else '?'}`"
+            " on every call; hoist into a cached factory keyed on the "
+            "static geometry"))
+
+    # RC002: unbounded cache around a parametrised jit factory
+    for fi in mod.funcs.values():
+        if not fi.params:
+            continue        # zero-arg factories cache exactly one entry
+        for dec in getattr(fi.node, "decorator_list", []):
+            if _maxsize_is_none(dec, mod) and has_cache_decorator(
+                    mod, fi.node) and (fi.is_factory
+                                       or _creates_jit(mod, fi)):
+                findings.append(Finding(
+                    path, fi.node.lineno, "RC002",
+                    f"lru_cache(maxsize=None) around jit factory "
+                    f"`{fi.qualname}` with parameters; compiled executables"
+                    " accumulate for the life of the process — bound the "
+                    "cache and quantize volatile keys"))
+                break
+
+    # RC003: unhashable/array values in static positions of known jits
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = prog.lookup(mod.resolve(node.func))
+        if callee is None and isinstance(node.func, ast.Name):
+            cand = mod.funcs.get(node.func.id)
+            if cand is not None and not cand.nested:
+                callee = cand
+        if callee is None or not callee.static or callee.jit_site is None:
+            continue
+        def bad(expr: ast.AST) -> Optional[str]:
+            if isinstance(expr, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                                 ast.DictComp, ast.SetComp)):
+                return "unhashable literal"
+            if _array_ctor_call(mod, expr):
+                return "array value"
+            return None
+        for i, a in enumerate(node.args):
+            if i < len(callee.params) and callee.params[i] in callee.static:
+                why = bad(a)
+                if why:
+                    findings.append(Finding(
+                        path, node.lineno, "RC003",
+                        f"{why} passed for static parameter "
+                        f"`{callee.params[i]}` of jitted "
+                        f"`{callee.qualname}`; static args must be "
+                        "hashable host constants"))
+        for kw in node.keywords:
+            if kw.arg in callee.static:
+                why = bad(kw.value)
+                if why:
+                    findings.append(Finding(
+                        path, node.lineno, "RC003",
+                        f"{why} passed for static parameter `{kw.arg}` of "
+                        f"jitted `{callee.qualname}`; static args must be "
+                        "hashable host constants"))

@@ -1,0 +1,300 @@
+"""trace-safety pass: concretization of traced values inside traced code.
+
+Taint = "this expression may be a JAX tracer".  Seeds are the non-static
+parameters of jit/pallas/scan-entered functions (discovered in context.py);
+taint flows through arithmetic, subscripts, and calls, and across module
+boundaries via the call graph (a helper invoked from a traced body with a
+tainted argument becomes traced in that parameter).  Flow-insensitive with
+a per-function fixpoint over assignments: once a name is tainted anywhere
+in a function it stays tainted, which errs toward reporting — the intended
+bias for a gate whose suppressions are cheap and explicit.
+
+Deliberately *not* tainted: ``.shape``/``.dtype``/``.ndim``-style static
+attributes, ``len()``/``isinstance()``-style host introspection, and
+``is``/``is not`` identity checks — so branching on geometry or config
+inside a jitted body stays clean, as it should.
+
+Rules: TS001 (Python control flow on a tracer), TS002 (bool/int/float
+concretization, including implicit ``and``/``or``/``not``), TS003
+(``.item()``/``.tolist()``/``np.asarray`` host materialization).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .common import Finding
+from .context import (FuncInfo, ModuleInfo, Program, STATIC_ATTRS,
+                      UNTAINTING_CALLS)
+
+# jax-namespace calls whose results are host values, not tracers.
+UNTAINTED_JAX = {
+    "jax.device_count", "jax.local_device_count", "jax.process_index",
+    "jax.process_count", "jax.devices", "jax.local_devices",
+    "jax.default_backend", "jax.eval_shape",
+}
+
+HOST_MATERIALIZERS = {"item", "tolist", "block_until_ready"}
+NP_MATERIALIZERS = {"numpy.asarray", "numpy.array", "numpy.frombuffer",
+                    "numpy.copy"}
+
+
+def _target_names(t: ast.AST) -> List[str]:
+    if isinstance(t, ast.Name):
+        return [t.id]
+    if isinstance(t, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for el in t.elts:
+            out.extend(_target_names(el))
+        return out
+    if isinstance(t, ast.Starred):
+        return _target_names(t.value)
+    return []
+
+
+class _FuncTaint:
+    """Per-function taint evaluation over one FuncInfo."""
+
+    def __init__(self, fi: FuncInfo, prog: Program):
+        self.fi = fi
+        self.mod: ModuleInfo = fi.module
+        self.prog = prog
+        self.tainted: Set[str] = set(fi.tainted)
+        self.pruned: Set[FuncInfo] = set()
+
+    def _walk(self):
+        """Walk this function's body, pruning nested defs/lambdas that are
+        separately registered as traced — they get their own analysis with
+        closure taint seeded in check()."""
+        stack = list(ast.iter_child_nodes(self.fi.node))
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                sub = self.mod.func_by_node.get(n) or \
+                    self.prog.lambda_info.get(n)
+                if sub is not None and sub is not self.fi and sub.traced:
+                    self.pruned.add(sub)
+                    continue
+            yield n
+            stack.extend(ast.iter_child_nodes(n))
+
+    # -- expression taint -------------------------------------------------
+    def expr(self, node: Optional[ast.AST]) -> bool:
+        if node is None or isinstance(node, ast.Constant):
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Attribute):
+            if node.attr in STATIC_ATTRS:
+                return False
+            return self.expr(node.value)
+        if isinstance(node, ast.Subscript):
+            return self.expr(node.value)
+        if isinstance(node, ast.Call):
+            return self._call_taint(node)
+        if isinstance(node, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return False
+            # `"key" in parts` probes the static structure of a host dict
+            # of tracers — dict membership never concretizes a tracer.
+            if all(isinstance(op, (ast.In, ast.NotIn)) for op in node.ops) \
+                    and isinstance(node.left, ast.Constant) \
+                    and isinstance(node.left.value, str):
+                return False
+            return self.expr(node.left) or any(
+                self.expr(c) for c in node.comparators)
+        if isinstance(node, (ast.BinOp,)):
+            return self.expr(node.left) or self.expr(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.expr(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any(self.expr(v) for v in node.values)
+        if isinstance(node, ast.IfExp):
+            return (self.expr(node.test) or self.expr(node.body)
+                    or self.expr(node.orelse))
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.expr(el) for el in node.elts)
+        if isinstance(node, ast.Starred):
+            return self.expr(node.value)
+        if isinstance(node, ast.NamedExpr):
+            return self.expr(node.value)
+        if isinstance(node, ast.JoinedStr):
+            return False
+        # conservative default: any child expression tainted
+        return any(self.expr(c) for c in ast.iter_child_nodes(node)
+                   if isinstance(c, ast.expr))
+
+    def _call_taint(self, node: ast.Call) -> bool:
+        r = self.mod.resolve(node.func)
+        if r in UNTAINTED_JAX:
+            return False
+        if isinstance(node.func, ast.Name) and \
+                node.func.id in UNTAINTING_CALLS:
+            return False
+        if r is not None and r.split(".")[-1] in UNTAINTING_CALLS \
+                and r.startswith("builtins."):
+            return False
+        args_tainted = any(self.expr(a) for a in node.args) or any(
+            self.expr(kw.value) for kw in node.keywords)
+        if args_tainted:
+            return True
+        # method call on a tracer (`x.sum()`, `y.reshape(...)`) yields a
+        # tracer; STATIC_ATTRS receivers (`x.shape.count(...)`) stay host
+        if isinstance(node.func, ast.Attribute):
+            return self.expr(node.func.value)
+        return False
+
+    # -- assignment fixpoint ----------------------------------------------
+    def fixpoint(self) -> None:
+        for _ in range(12):
+            changed = False
+            for node in self._walk():
+                new: List[str] = []
+                if isinstance(node, ast.Assign) and self.expr(node.value):
+                    for t in node.targets:
+                        new.extend(_target_names(t))
+                elif isinstance(node, ast.AnnAssign) and node.value is not \
+                        None and self.expr(node.value):
+                    new.extend(_target_names(node.target))
+                elif isinstance(node, ast.AugAssign) and \
+                        (self.expr(node.value) or self.expr(node.target)):
+                    new.extend(_target_names(node.target))
+                elif isinstance(node, ast.For) and self.expr(node.iter):
+                    new.extend(_target_names(node.target))
+                elif isinstance(node, ast.NamedExpr) and \
+                        self.expr(node.value):
+                    new.extend(_target_names(node.target))
+                elif isinstance(node, ast.withitem) and \
+                        node.optional_vars is not None and \
+                        self.expr(node.context_expr):
+                    new.extend(_target_names(node.optional_vars))
+                fresh = set(new) - self.tainted
+                if fresh:
+                    self.tainted |= fresh
+                    changed = True
+            if not changed:
+                return
+
+    # -- checks -----------------------------------------------------------
+    def check(self) -> Tuple[List[Finding], Dict[FuncInfo, Set[str]]]:
+        findings: List[Finding] = []
+        callee_taint: Dict[FuncInfo, Set[str]] = {}
+        mod, path = self.mod, self.mod.path
+
+        def flag(node: ast.AST, rule: str, msg: str) -> None:
+            findings.append(Finding(path, node.lineno, rule, msg))
+
+        for node in self._walk():
+            if isinstance(node, ast.If) and self.expr(node.test):
+                flag(node, "TS001",
+                     "`if` on a traced value inside traced function "
+                     f"`{self.fi.qualname}`; use jnp.where/lax.cond")
+            elif isinstance(node, ast.While) and self.expr(node.test):
+                flag(node, "TS001",
+                     "`while` on a traced value inside traced function "
+                     f"`{self.fi.qualname}`; use lax.while_loop")
+            elif isinstance(node, ast.IfExp) and self.expr(node.test):
+                flag(node, "TS001",
+                     "ternary on a traced value inside traced function "
+                     f"`{self.fi.qualname}`; use jnp.where")
+            elif isinstance(node, ast.Assert) and self.expr(node.test):
+                flag(node, "TS001",
+                     "`assert` concretizes a traced value inside traced "
+                     f"function `{self.fi.qualname}`; use checkify or drop")
+            elif isinstance(node, ast.For):
+                it = node.iter
+                if isinstance(it, ast.Call) and isinstance(
+                        it.func, ast.Name) and it.func.id in (
+                        "range", "enumerate") and any(
+                        self.expr(a) for a in it.args):
+                    flag(node, "TS001",
+                         "`for` over a traced extent inside traced "
+                         f"function `{self.fi.qualname}`; use lax.fori_loop")
+            elif isinstance(node, ast.BoolOp) and any(
+                    self.expr(v) for v in node.values):
+                flag(node, "TS002",
+                     "`and`/`or` implicitly calls bool() on a traced value "
+                     f"in `{self.fi.qualname}`; use jnp.logical_and/or")
+            elif isinstance(node, ast.UnaryOp) and isinstance(
+                    node.op, ast.Not) and self.expr(node.operand):
+                flag(node, "TS002",
+                     "`not` implicitly calls bool() on a traced value in "
+                     f"`{self.fi.qualname}`; use jnp.logical_not")
+            elif isinstance(node, ast.Call):
+                self._check_call(node, flag, callee_taint)
+        # closure taint into pruned nested traced defs
+        for sub in self.pruned:
+            loads = {n.id for n in ast.walk(sub.node)
+                     if isinstance(n, ast.Name)
+                     and isinstance(n.ctx, ast.Load)}
+            fresh = (self.tainted & loads) - set(sub.params)
+            if fresh:
+                callee_taint.setdefault(sub, set()).update(fresh)
+        return findings, callee_taint
+
+    def _check_call(self, node: ast.Call, flag, callee_taint) -> None:
+        mod = self.mod
+        if isinstance(node.func, ast.Name) and node.func.id in (
+                "bool", "int", "float") and node.args and \
+                self.expr(node.args[0]):
+            flag(node, "TS002",
+                 f"{node.func.id}() concretizes a traced value in "
+                 f"`{self.fi.qualname}`; keep it as an array or make the "
+                 "argument static")
+            return
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in ("item", "tolist") and \
+                self.expr(node.func.value):
+            flag(node, "TS003",
+                 f".{node.func.attr}() materializes a traced value on the "
+                 f"host in `{self.fi.qualname}`")
+            return
+        r = mod.resolve(node.func)
+        if r in NP_MATERIALIZERS and node.args and self.expr(node.args[0]):
+            flag(node, "TS003",
+                 f"{r.split('.')[-1]}() pulls a traced value to host "
+                 f"numpy in `{self.fi.qualname}`")
+            return
+        # cross-function propagation
+        callee = self.prog.lookup(r)
+        if callee is None and isinstance(node.func, ast.Name):
+            local = mod.funcs.get(node.func.id)
+            if local is not None and not local.nested:
+                callee = local
+        if callee is None or callee is self.fi:
+            return
+        hit: Set[str] = set()
+        for i, a in enumerate(node.args):
+            if i < len(callee.params) and self.expr(a):
+                hit.add(callee.params[i])
+        for kw in node.keywords:
+            if kw.arg in callee.params and self.expr(kw.value):
+                hit.add(kw.arg)
+        if hit:
+            callee_taint.setdefault(callee, set()).update(hit)
+
+
+def run(prog: Program) -> List[Finding]:
+    findings: Dict[Tuple[str, int, str, str], Finding] = {}
+    work: List[FuncInfo] = [fi for m in prog.modules
+                            for fi in m.funcs.values() if fi.traced]
+    seen_rounds: Dict[str, int] = {}
+    while work:
+        fi = work.pop()
+        seen_rounds[fi.ref] = seen_rounds.get(fi.ref, 0) + 1
+        if seen_rounds[fi.ref] > 8:        # cycle guard
+            continue
+        ft = _FuncTaint(fi, prog)
+        ft.fixpoint()
+        found, callee_taint = ft.check()
+        for f in found:
+            findings[(f.path, f.line, f.rule, f.message)] = f
+        for callee, params in callee_taint.items():
+            fresh = params - callee.tainted - callee.static
+            if fresh or not callee.traced:
+                callee.traced = True
+                callee.tainted |= fresh
+                work.append(callee)
+    return sorted(findings.values(), key=lambda f: (f.path, f.line, f.rule))
