@@ -502,10 +502,54 @@ def _scenario_parity():
             "first_divergence": first_div}
 
 
+def _scenario_resilience():
+    """Resilience sweep: all single-node failures of a 128-node snapshot as
+    ONE batched device solve (resilience/analyzer.py).  The per-scenario
+    headroom budget is capped so the CPU fallback stays inside the scenario
+    timeout; the metric is scenarios/sec for the whole N-1 sweep."""
+    from cluster_capacity_tpu.models.podspec import default_pod
+    from cluster_capacity_tpu.models.snapshot import ClusterSnapshot
+    from cluster_capacity_tpu.resilience import analyze, single_node_scenarios
+    from cluster_capacity_tpu.utils.config import SchedulerProfile
+
+    n_nodes = int(os.environ.get("BENCH_RESILIENCE_NODES", "128"))
+    limit = int(os.environ.get("BENCH_RESILIENCE_LIMIT", "256"))
+    snapshot = ClusterSnapshot.from_objects(
+        _make_nodes(n_nodes=n_nodes, seed=11))
+    probe = default_pod({
+        "metadata": {"name": "bench-probe"},
+        "spec": {"containers": [{
+            "name": "c0", "resources": {"requests": {
+                "cpu": "100m", "memory": "256Mi"}}}]},
+    })
+    profile = SchedulerProfile()
+    scenarios = single_node_scenarios(snapshot)
+    # warmup covers the batched chunk compile; same snapshot → the timed run
+    # replays cached executables (one compile per static geometry)
+    analyze(snapshot, scenarios, probe, profile=profile, max_limit=limit,
+            dedup=False)
+    t0 = time.perf_counter()
+    report = analyze(snapshot, scenarios, probe, profile=profile,
+                     max_limit=limit, dedup=False)
+    dt = time.perf_counter() - t0
+    # the deduped sweep is the production default — time it too
+    t0 = time.perf_counter()
+    deduped = analyze(snapshot, scenarios, probe, profile=profile,
+                      max_limit=limit)
+    dt_dedup = time.perf_counter() - t0
+    return {"sps": len(scenarios) / dt, "nodes": n_nodes,
+            "scenarios": len(scenarios),
+            "batched": report.batched_scenarios,
+            "sequential": report.sequential_scenarios,
+            "dedup_sps": len(scenarios) / dt_dedup,
+            "collapsed": deduped.collapsed_scenarios}
+
+
 _SCENARIOS = {"fast": _scenario_fast, "scan": _scenario_scan,
               "ipa": _scenario_ipa, "sweep": _scenario_sweep,
               "c5": _scenario_c5,
               "interleave": _scenario_interleave,
+              "resilience": _scenario_resilience,
               "parity": _scenario_parity}
 
 
@@ -579,6 +623,7 @@ def main() -> None:
     c5 = _run_scenario("c5", accel,
                        int(os.environ.get("BENCH_C5_TIMEOUT", "1200")))
     il = _run_scenario("interleave", accel, timeout)
+    res = _run_scenario("resilience", accel, timeout)
     par = _run_scenario("parity", accel, timeout)
 
     platform = (sc or fp or ipa or sw or {}).get("platform", "none")
@@ -623,6 +668,13 @@ def main() -> None:
         if "ext_pps" in il:
             out["interleave_extender_placements_per_sec"] = round(
                 il["ext_pps"], 2)
+    if res:
+        out["resilience_scenarios_per_sec"] = round(res["sps"], 2)
+        out["resilience_dedup_scenarios_per_sec"] = round(res["dedup_sps"], 2)
+        out["resilience_nodes"] = res["nodes"]
+        out["resilience_scenarios"] = res["scenarios"]
+        out["resilience_batched"] = res["batched"]
+        out["resilience_collapsed"] = res["collapsed"]
     if par:
         out["parity_f32_matches_f64"] = par["f32_matches_f64"]
         out["parity_steps_compared"] = par["steps_compared"]

@@ -2,8 +2,8 @@
 
 Mirrors /root/reference/cmd/hypercc/main.go:30-39 — dispatch on the basename
 the binary was invoked as (or the first argument): `cluster-capacity`,
-`genpod`, or the `hypercc` umbrella.  `python -m cluster_capacity_tpu` routes
-here.
+`genpod`, `resilience`, or the `hypercc` umbrella.  `python -m
+cluster_capacity_tpu` routes here.
 """
 
 from __future__ import annotations
@@ -14,10 +14,12 @@ from typing import List, Optional
 
 from . import cluster_capacity as cc_cli
 from . import genpod as genpod_cli
+from . import resilience as resilience_cli
 
 _COMMANDS = {
     "cluster-capacity": cc_cli.run,
     "genpod": genpod_cli.run,
+    "resilience": resilience_cli.run,
 }
 
 
@@ -36,7 +38,8 @@ def run(argv: Optional[List[str]] = None) -> int:
     prog = "hypercc"
     print(f"usage: {prog} <command> [flags]\n\ncommands:\n"
           "  cluster-capacity   estimate schedulable instances of a pod\n"
-          "  genpod             generate a pod spec from namespace limits\n",
+          "  genpod             generate a pod spec from namespace limits\n"
+          "  resilience         N-k failure sweeps with drain re-scheduling\n",
           file=sys.stderr)
     return 0 if argv and argv[0] in ("-h", "--help") else 1
 

@@ -1,0 +1,157 @@
+"""resilience CLI front-end: batched N-k failure sweeps.
+
+Offline-only (a failure sweep is a what-if study): cluster state comes from
+--snapshot (YAML/JSON objects or a .npz checkpoint), scenarios from the
+mode flags, and the probe template from --podspec (defaulting to a small
+100m/200Mi pod — the scheduler's NonZeroRequested defaults).  Emits the
+survivability report through utils/report.print_survivability in table,
+json, or yaml form.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from ..models.podspec import default_pod, parse_pod_text, validate_pod
+from ..utils.config import SchedulerProfile, load_scheduler_config
+from ..utils.report import print_survivability
+from ..utils.snapshot_io import load_snapshot_objects
+from .cluster_capacity import _read_podspec
+
+# the scheduler's NonZeroRequested defaults (util.DefaultMilliCPURequest /
+# DefaultMemoryRequest) — a probe that fits wherever anything fits
+_DEFAULT_PROBE = {
+    "metadata": {"name": "resilience-probe"},
+    "spec": {"containers": [{
+        "name": "probe",
+        "resources": {"requests": {"cpu": "100m", "memory": "200Mi"}},
+    }]},
+}
+
+
+def build_parser(prog: str = "resilience") -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog=prog,
+        description=("Survivability analysis: for each failure scenario, "
+                     "drain + re-schedule the displaced pods onto the "
+                     "survivors and measure the remaining probe headroom."))
+    p.add_argument("--snapshot", default="", required=False,
+                   help="Path to a cluster-snapshot YAML/JSON file or .npz "
+                        "checkpoint (required).")
+    p.add_argument("--podspec", default="",
+                   help="Path to JSON or YAML probe pod definition "
+                        "(http(s):// URLs accepted). Default: a 100m/200Mi "
+                        "probe pod.")
+    p.add_argument("--nodes", action="store_true",
+                   help="Every single-node failure (the default mode when "
+                        "no other scenario flag is given).")
+    p.add_argument("--zones", nargs="?", const="topology.kubernetes.io/zone",
+                   default="", metavar="LABEL_KEY",
+                   help="One scenario per distinct value of a topology "
+                        "label key (default key: topology.kubernetes.io/"
+                        "zone).")
+    p.add_argument("--random-k", dest="random_k", type=int, default=0,
+                   help="Random N-k sampling: fail k nodes at a time.")
+    p.add_argument("--samples", type=int, default=16,
+                   help="Number of random N-k samples (with --random-k).")
+    p.add_argument("--seed", type=int, default=0,
+                   help="RNG seed for --random-k sampling.")
+    p.add_argument("--drain", action="append", default=[],
+                   help="Explicit drain list: comma-separated node names "
+                        "failed together. May be repeated, one scenario "
+                        "each.")
+    p.add_argument("--max-limit", dest="max_limit", type=int, default=0,
+                   help="Cap the per-scenario headroom count. By default "
+                        "unlimited.")
+    p.add_argument("--default-config", dest="default_config", default="",
+                   help="Path to KubeSchedulerConfiguration file.")
+    p.add_argument("--parity", action="store_true",
+                   help="Bit-exact kube-scheduler score arithmetic "
+                        "(float64).")
+    p.add_argument("--no-dedup", dest="no_dedup", action="store_true",
+                   help="Solve every scenario separately instead of "
+                        "collapsing symmetric single-node failures.")
+    p.add_argument("--verbose", action="store_true", help="Verbose mode")
+    p.add_argument("-o", "--output", default="",
+                   help="Output format. One of: json|yaml.")
+    return p
+
+
+def run(argv: Optional[List[str]] = None, prog: str = "resilience") -> int:
+    args = build_parser(prog).parse_args(argv)
+
+    if not args.snapshot:
+        print("Error: --snapshot is required (failure sweeps are offline "
+              "what-if studies)", file=sys.stderr)
+        return 1
+    if args.output not in ("", "json", "yaml"):
+        print(f"Error: output format {args.output!r} not recognized",
+              file=sys.stderr)
+        return 1
+    if args.random_k < 0 or args.samples <= 0:
+        print("Error: --random-k and --samples must be positive",
+              file=sys.stderr)
+        return 1
+
+    if args.podspec:
+        probe = default_pod(parse_pod_text(_read_podspec(args.podspec)))
+    else:
+        probe = default_pod(_DEFAULT_PROBE)
+    validate_pod(probe)
+
+    profile = (load_scheduler_config(args.default_config)
+               if args.default_config else SchedulerProfile())
+    if args.parity:
+        profile.compute_dtype = "float64"
+
+    if args.snapshot.endswith(".npz"):
+        from ..utils.checkpoint import load as load_checkpoint
+        snapshot = load_checkpoint(args.snapshot)
+    else:
+        from ..models.snapshot import ClusterSnapshot
+        objs = load_snapshot_objects(args.snapshot)
+        snapshot = ClusterSnapshot.from_objects(
+            objs.pop("nodes", []), objs.pop("pods", []), **objs)
+
+    from ..resilience import (analyze, drain_list_scenario,
+                              random_nk_scenarios, single_node_scenarios,
+                              zone_scenarios)
+    scenarios = []
+    explicit = args.zones or args.random_k or args.drain
+    if args.nodes or not explicit:
+        scenarios.extend(single_node_scenarios(snapshot))
+    if args.zones:
+        scenarios.extend(zone_scenarios(snapshot, key=args.zones))
+    if args.random_k:
+        try:
+            scenarios.extend(random_nk_scenarios(
+                snapshot, args.random_k, args.samples, seed=args.seed))
+        except ValueError as e:
+            print(f"Error: {e}", file=sys.stderr)
+            return 1
+    for spec in args.drain:
+        names = [s for s in spec.split(",") if s]
+        try:
+            scenarios.append(drain_list_scenario(snapshot, names))
+        except ValueError as e:
+            print(f"Error: {e}", file=sys.stderr)
+            return 1
+    if not scenarios:
+        print("Error: no scenarios (snapshot has no nodes?)",
+              file=sys.stderr)
+        return 1
+
+    report = analyze(snapshot, scenarios, probe, profile=profile,
+                     max_limit=args.max_limit, dedup=not args.no_dedup)
+    print_survivability(report, verbose=args.verbose, fmt=args.output)
+    return 0
+
+
+def main() -> None:
+    sys.exit(run())
+
+
+if __name__ == "__main__":
+    main()

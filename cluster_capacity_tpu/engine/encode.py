@@ -41,8 +41,15 @@ CODE_IPA_EXISTING_ANTI = 11
 # (volume plugin failures flow through the separate volume_mask/volume_reasons
 # channel — they sit between fit and spread in diagnosis order)
 CODE_DRA = 12
+# resilience sweeps: node simulated as failed/drained (resilience/) — folded
+# before every real filter so a dead node always diagnoses as dead, not as
+# whatever plugin would also have rejected it
+CODE_NODE_FAILED = 13
+
+REASON_NODE_FAILED = "node(s) were simulated as failed"
 
 STATIC_REASONS = {
+    CODE_NODE_FAILED: REASON_NODE_FAILED,
     CODE_UNSCHEDULABLE: node_unschedulable.REASON,
     CODE_NODE_NAME: node_name.REASON,
     CODE_NODE_AFFINITY: node_affinity.REASON,
@@ -120,15 +127,33 @@ class EncodedProblem:
     spread_ignored: np.ndarray     # bool[N] — score-pass ignored nodes
     ipa: inter_pod_affinity.AffinityEncoding
 
+    # resilience sweeps: nodes surviving the alive_mask (== N when no mask).
+    # Sampling (percentageOfNodesToScore) reads this, not the axis length —
+    # masked-out nodes are not part of the cluster being scored.
+    num_alive: int
     max_steps_hint: int            # fit-based upper bound on placements
 
 
 def encode_problem(snapshot: ClusterSnapshot, pod: dict,
                    profile: SchedulerProfile,
-                   ipa_extra_keys=()) -> EncodedProblem:
+                   ipa_extra_keys=(), alive_mask=None) -> EncodedProblem:
     """ipa_extra_keys: extra InterPodAffinity topology-key group rows (see
-    ops/inter_pod_affinity.encode) for the tensor interleave engine."""
+    ops/inter_pod_affinity.encode) for the tensor interleave engine.
+
+    alive_mask: optional bool[N] for resilience sweeps (resilience/) — nodes
+    marked False are simulated as failed: they fold into static_mask/static_code
+    ahead of every plugin filter, their static raw scores zero out, and they
+    drop from max_steps_hint.  Because every solver reads feasibility and
+    static scores through those planes, the mask rides the XLA scan and the
+    fused Pallas kernel with no solver changes (fused.py packs static_mask as
+    the first [S, 128] const plane)."""
     n = snapshot.num_nodes
+    alive = None
+    if alive_mask is not None:
+        alive = np.asarray(alive_mask, dtype=bool)
+        if alive.shape != (n,):
+            raise ValueError(
+                f"alive_mask shape {alive.shape} != ({n},)")
 
     # --- pod request vectors ------------------------------------------------
     reqs = ps.pod_requests(pod)
@@ -261,6 +286,8 @@ def encode_problem(snapshot: ClusterSnapshot, pod: dict,
                   where=(static_code == CODE_OK) & ~mask)
         masks.append(mask)
 
+    if alive is not None:
+        fold(alive, CODE_NODE_FAILED)
     if enabled("NodeUnschedulable"):
         fold(node_unschedulable.static_mask(snapshot, pod), CODE_UNSCHEDULABLE)
     if enabled("NodeName"):
@@ -314,6 +341,12 @@ def encode_problem(snapshot: ClusterSnapshot, pod: dict,
         if na_active and profile.score_weight("NodeAffinity") else np.zeros(n)
     il_score = image_locality.static_score(snapshot, pod) \
         if profile.score_weight("ImageLocality") else np.zeros(n)
+    if alive is not None:
+        # failed nodes can never host the pod, but their raws would still
+        # shift normalization windows in the fast path's uniformity checks
+        taint_raw = np.where(alive, taint_raw, 0.0)
+        na_raw = np.where(alive, na_raw, 0.0)
+        il_score = np.where(alive, il_score, 0.0)
 
     # --- stateful plugins ---------------------------------------------------
     if enabled("PodTopologySpread"):
@@ -394,5 +427,6 @@ def encode_problem(snapshot: ClusterSnapshot, pod: dict,
         node_affinity_active=na_active, image_locality_score=il_score,
         spread_hard=spread_hard, spread_soft=spread_soft,
         spread_ignored=spread_ignored, ipa=ipa,
+        num_alive=int(alive.sum()) if alive is not None else n,
         max_steps_hint=hint,
     )

@@ -74,7 +74,7 @@ def _structural_eligible(pb: enc.EncodedProblem) -> bool:
         return False
     if pb.dra_shared_colocate:
         return False
-    if sim._num_feasible_nodes_to_find(profile, pb.snapshot.num_nodes) > 0:
+    if sim._num_feasible_nodes_to_find(profile, pb.num_alive) > 0:
         return False
     return True
 

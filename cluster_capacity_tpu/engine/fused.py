@@ -252,6 +252,9 @@ def _pack_meta(cfg: sim.StaticConfig, pb, consts) -> _Packing:
         has_static_pref=bool(cfg.ipa_score_active),
     )
 
+    # static_mask leads the const planes; a resilience alive_mask (encode.py)
+    # arrives pre-folded into it, so masked-failed nodes read as statically
+    # infeasible inside the kernel with no extra plane or branch
     const_names = ["static_mask"]
     if cfg.volume_filter_on:
         const_names.append("volume_mask")

@@ -149,7 +149,9 @@ def static_config(pb: enc.EncodedProblem) -> StaticConfig:
         bal_idx=tuple(int(j) for j in pb.balanced_res_idx),
         ipa_static_empty=bool(ipa.aff_init.sum() == 0),
         ss_onehot_ok=_soft_nonhost_domains(pb.spread_soft) <= _ONEHOT_DOMAIN_CAP,
-        sample_k=_num_feasible_nodes_to_find(profile, pb.snapshot.num_nodes),
+        # num_alive, not the axis length: nodes masked out by a resilience
+        # alive_mask are not part of the cluster percentageOfNodesToScore sees
+        sample_k=_num_feasible_nodes_to_find(profile, pb.num_alive),
     )
 
 

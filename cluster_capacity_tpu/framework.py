@@ -33,6 +33,7 @@ class ClusterCapacity:
         self.exclude_nodes = list(exclude_nodes)
         self.snapshot: Optional[ClusterSnapshot] = None
         self._result: Optional[SolveResult] = None
+        self._final_snapshot: Optional[ClusterSnapshot] = None
 
     def sync_with_objects(self, nodes: Sequence[dict],
                           pods: Sequence[dict] = (), **extra) -> None:
@@ -227,6 +228,7 @@ class ClusterCapacity:
                        for k in snapshot_mod.OBJECT_FIELDS})
             snap = next_snap
 
+        self._final_snapshot = snap
         if result is None:
             result = solve_auto(encode_problem(snapshot, self.pod, profile),
                                 max_limit=self.max_limit)
@@ -237,6 +239,16 @@ class ClusterCapacity:
         result.placements = placements
         result.placed_count = len(placements)
         return result
+
+    @property
+    def post_run_snapshot(self) -> Optional[ClusterSnapshot]:
+        """The working snapshot after run()'s preemption loop: the installed
+        snapshot unless the loop advanced it (evictions, plus clones committed
+        on resume — the final cycle's placements are never committed).  The
+        resilience drain loop reads this to carry preemption effects from one
+        displaced pod's re-scheduling into the next's."""
+        return self._final_snapshot if self._final_snapshot is not None \
+            else self.snapshot
 
     def report(self) -> ClusterCapacityReview:
         if self._result is None:

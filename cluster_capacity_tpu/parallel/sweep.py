@@ -321,6 +321,20 @@ def _group_uniform(arrs: List[np.ndarray]) -> bool:
     return all(np.array_equal(a, a0) for a in rest)
 
 
+def solve_group(pbs: List[enc.EncodedProblem], max_limit: int = 0,
+                mesh=None) -> List[sim.SolveResult]:
+    """Public batched-group entry for pre-encoded problems.
+
+    The resilience analyzer (resilience/analyzer.py) encodes one problem per
+    failure scenario — same probe and profile, per-scenario alive_mask folded
+    into static_mask — and solves the family here as ONE batched device solve:
+    the scenario axis batches exactly like sweep()'s template axis.  Callers
+    must pass problems sharing a group key (_group_key) and batchable shape
+    (_batchable); sweep() derives those itself.
+    """
+    return _batched_solve(list(pbs), max_limit, mesh=mesh)
+
+
 def _batched_solve(pbs: List[enc.EncodedProblem], max_limit: int,
                    mesh=None) -> List[sim.SolveResult]:
     import jax

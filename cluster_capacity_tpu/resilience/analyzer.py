@@ -1,0 +1,402 @@
+"""Batched N-k failure sweeps with drain re-scheduling.
+
+Semantics contract: the ground truth for "node X failed" is a snapshot with
+node X physically deleted.  The fast path instead marks X dead through an
+encode-time alive_mask (engine/encode.py) and solves ALL scenarios as one
+batched device solve (parallel/sweep.solve_group) — the scenario axis
+batches exactly like the sweep's template axis, and the mask rides the
+packed static planes through the XLA scan and the fused Pallas kernel.
+Masking is used only when it is bit-identical to deletion for the probe at
+hand (_mask_exact); otherwise the scenario falls back to a sequential solve
+on the physically deleted snapshot — the same eligibility-gate + fallback
+shape as engine/fast_path.solve_auto.
+
+Drain ordering: pods resident on failed nodes are re-queued
+highest-priority-first (ops/priority_sort — the PrioritySort queue order)
+and re-scheduled one at a time onto the survivors through
+framework.ClusterCapacity with max_limit=1, i.e. the full run loop:
+DefaultPreemption may evict lower-priority victims (PDB-aware,
+engine/preemption.py) to make room, and each pod's outcome feeds the next
+pod's snapshot.  A pod that cannot be re-scheduled even with preemption
+counts as stranded.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..engine import encode as enc
+from ..engine import simulator as sim
+from ..engine.fast_path import solve_auto
+from ..models import snapshot as snapshot_mod
+from ..models.snapshot import ClusterSnapshot
+from ..ops.priority_sort import sort_pods
+from ..parallel import sweep
+from ..utils.config import SchedulerProfile
+from .scenarios import FailureScenario, dedup_single_node
+
+
+@dataclass
+class ScenarioResult:
+    name: str
+    kind: str
+    k: int
+    failed_nodes: List[str]
+    displaced: int              # pods resident on the failed nodes
+    replaced: int               # displaced pods re-scheduled onto survivors
+    stranded: int               # displaced pods with nowhere to go
+    preempted: int              # victims evicted to make room for displaced
+    headroom: int               # probe clones the degraded cluster still fits
+    fail_message: str = ""
+    batched: bool = False       # solved via the masked batched path
+    deduped_of: Optional[str] = None   # metrics copied from this scenario
+    probe_placements: Optional[List[str]] = None  # node names, when kept
+
+
+@dataclass
+class DrainOutcome:
+    displaced: int
+    replaced: int
+    stranded: int
+    preempted: int
+    final_deleted_snapshot: Optional[ClusterSnapshot]
+    stranded_messages: List[str] = field(default_factory=list)
+
+
+@dataclass
+class SurvivabilityReport:
+    probe_name: str
+    num_nodes: int
+    baseline_headroom: int
+    scenarios: List[ScenarioResult]
+    collapsed_scenarios: int    # symmetric duplicates not solved separately
+    batched_scenarios: int
+    sequential_scenarios: int
+
+    @property
+    def min_k_to_stranded(self) -> Optional[int]:
+        ks = [r.k for r in self.scenarios if r.stranded > 0]
+        return min(ks) if ks else None
+
+    @property
+    def min_k_to_zero_headroom(self) -> Optional[int]:
+        ks = [r.k for r in self.scenarios if r.headroom == 0]
+        return min(ks) if ks else None
+
+    def worst_nodes(self, top: int = 10) -> List[Tuple[str, int, int]]:
+        """Single-node scenarios ranked worst-first: most stranded pods,
+        then least remaining headroom.  (name, headroom, stranded) tuples."""
+        singles = [r for r in self.scenarios if r.kind == "node" and r.k == 1]
+        singles.sort(key=lambda r: (-r.stranded, r.headroom, r.name))
+        return [(r.failed_nodes[0], r.headroom, r.stranded)
+                for r in singles[:top]]
+
+    def headroom_curve(self) -> List[Tuple[int, str, int]]:
+        """Per-scenario (k, name, headroom), ascending in k — the
+        degradation curve an operator reads min-k thresholds from."""
+        return sorted((r.k, r.name, r.headroom) for r in self.scenarios)
+
+    def to_dict(self) -> dict:
+        """Stable machine-readable schema: the same {"spec", "status"}
+        envelope as utils/report.ClusterCapacityReview.to_dict."""
+        return {
+            "spec": {
+                "probe": {"podName": self.probe_name},
+                "numNodes": self.num_nodes,
+                "numScenarios": len(self.scenarios),
+            },
+            "status": {
+                "baselineHeadroom": self.baseline_headroom,
+                "collapsedScenarios": self.collapsed_scenarios,
+                "batchedScenarios": self.batched_scenarios,
+                "sequentialScenarios": self.sequential_scenarios,
+                "minKToStranded": self.min_k_to_stranded,
+                "minKToZeroHeadroom": self.min_k_to_zero_headroom,
+                "worstNodes": [
+                    {"nodeName": nm, "headroom": h, "stranded": s}
+                    for nm, h, s in self.worst_nodes()],
+                "headroomCurve": [
+                    {"k": k, "name": nm, "headroom": h}
+                    for k, nm, h in self.headroom_curve()],
+                "scenarios": [
+                    {"name": r.name, "kind": r.kind, "k": r.k,
+                     "failedNodes": list(r.failed_nodes),
+                     "displaced": r.displaced, "replaced": r.replaced,
+                     "stranded": r.stranded, "preempted": r.preempted,
+                     "headroom": r.headroom,
+                     "failMessage": r.fail_message,
+                     "batched": r.batched,
+                     "dedupedOf": r.deduped_of}
+                    for r in self.scenarios],
+            },
+        }
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "SurvivabilityReport":
+        spec, status = data["spec"], data["status"]
+        return cls(
+            probe_name=spec["probe"]["podName"],
+            num_nodes=spec["numNodes"],
+            baseline_headroom=status["baselineHeadroom"],
+            scenarios=[
+                ScenarioResult(
+                    name=s["name"], kind=s["kind"], k=s["k"],
+                    failed_nodes=list(s["failedNodes"]),
+                    displaced=s["displaced"], replaced=s["replaced"],
+                    stranded=s["stranded"], preempted=s["preempted"],
+                    headroom=s["headroom"],
+                    fail_message=s.get("failMessage", ""),
+                    batched=s.get("batched", False),
+                    deduped_of=s.get("dedupedOf"))
+                for s in status["scenarios"]],
+            collapsed_scenarios=status["collapsedScenarios"],
+            batched_scenarios=status["batchedScenarios"],
+            sequential_scenarios=status["sequentialScenarios"],
+        )
+
+
+def _mask_exact(pb: enc.EncodedProblem, probe: dict) -> bool:
+    """True when marking failed nodes infeasible via alive_mask is
+    bit-identical to physically deleting them, for THIS probe.
+
+    Per-node static state (fit, taints, required+preferred node affinity,
+    unschedulable, node name, ports) is identical either way, and score
+    normalization runs over non-negative raws that encode_problem zeroes on
+    dead nodes, so the normalization window matches the survivor set.  What
+    breaks exactness — and forces the sequential deleted-snapshot path:
+
+    - topology spread: a deleted node can empty a domain; a masked one
+      leaves it countable with zero capacity, shifting global min-domain /
+      min-count terms
+    - inter-pod affinity: domain existence and the lonely-pod escape read
+      global existing-pod structure
+    - ImageLocality: the spread ratio divides by the TOTAL node count
+    - sampling (percentageOfNodesToScore / adaptive): reads the node count
+    - nondeterministic scoring: the tie-break rotation spans the full axis
+    - extenders: webhook verdicts are computed per real node list
+    - shared DRA claims: charged cross-node at the first placement
+    - non-batchable shapes (host-port / disk / RWOP clone self-conflicts,
+      pod-level gates): the batched runner rejects them anyway
+    """
+    profile = pb.profile
+    if not profile.deterministic:
+        return False
+    if profile.extenders:
+        return False
+    if profile.adaptive_sampling or profile.percentage_of_nodes_to_score < 100:
+        return False
+    if pb.spread_hard.num_constraints or pb.spread_soft.num_constraints:
+        return False
+    if pb.ipa.active or pb.ipa.existing_anti_static.any():
+        return False
+    if pb.image_locality_score.any():
+        return False
+    if (probe.get("spec") or {}).get("volumes"):
+        return False
+    if pb.shared_req_vec.any():
+        return False
+    if not sweep._batchable(pb):
+        return False
+    return True
+
+
+def _delete_nodes(snapshot: ClusterSnapshot,
+                  failed: Sequence[int]) -> ClusterSnapshot:
+    """The ground-truth degraded snapshot: failed nodes and their resident
+    pods removed, axis order of the survivors preserved."""
+    dead = set(failed)
+    keep = [i for i in range(snapshot.num_nodes) if i not in dead]
+    return ClusterSnapshot.from_objects(
+        [snapshot.nodes[i] for i in keep],
+        [p for i in keep for p in snapshot.pods_by_node[i]],
+        sort_nodes=False,
+        **{k: getattr(snapshot, k) for k in snapshot_mod.OBJECT_FIELDS})
+
+
+def _drain(snapshot: ClusterSnapshot, scenario: FailureScenario,
+           profile: SchedulerProfile) -> DrainOutcome:
+    """Re-schedule the failed nodes' pods onto the survivors,
+    highest-priority-first, through the full framework run loop (preemption
+    included).  Returns the final deleted-axis snapshot with replaced pods
+    committed and victims evicted."""
+    from ..framework import ClusterCapacity
+
+    displaced = [p for i in scenario.failed
+                 for p in snapshot.pods_by_node[i]]
+    cur = _delete_nodes(snapshot, scenario.failed)
+    replaced = stranded = preempted = 0
+    messages: List[str] = []
+    for pod in sort_pods(displaced, snapshot.priority_classes):
+        pending = copy.deepcopy(pod)
+        pending.setdefault("spec", {}).pop("nodeName", None)
+        cc = ClusterCapacity(pending, max_limit=1, profile=profile)
+        cc.set_snapshot(cur, sort_nodes=False)
+        result = cc.run()
+        after = cc.post_run_snapshot
+        preempted += (sum(len(p) for p in cur.pods_by_node)
+                      - sum(len(p) for p in after.pods_by_node))
+        cur = after
+        if result.placed_count >= 1:
+            tgt = int(result.placements[0])
+            committed = copy.deepcopy(pod)
+            committed.setdefault("spec", {})["nodeName"] = cur.node_names[tgt]
+            pbn = [list(p) for p in cur.pods_by_node]
+            pbn[tgt].append(committed)
+            nxt = snapshot_mod.with_pods_by_node(cur, pbn, [tgt])
+            if nxt is None:
+                nxt = ClusterSnapshot.from_objects(
+                    cur.nodes, [p for plist in pbn for p in plist],
+                    sort_nodes=False,
+                    **{k: getattr(cur, k)
+                       for k in snapshot_mod.OBJECT_FIELDS})
+            cur = nxt
+            replaced += 1
+        else:
+            stranded += 1
+            messages.append(result.fail_message)
+    return DrainOutcome(displaced=len(displaced), replaced=replaced,
+                        stranded=stranded, preempted=preempted,
+                        final_deleted_snapshot=cur,
+                        stranded_messages=messages)
+
+
+def _post_drain_full_axis(snapshot: ClusterSnapshot, scenario: FailureScenario,
+                         drain: DrainOutcome) -> ClusterSnapshot:
+    """Map the drain's deleted-axis end state back onto the FULL node axis
+    for the masked batched solve: failed nodes keep their row with an empty
+    roster (the alive_mask makes them infeasible); survivors take their
+    post-drain rosters."""
+    final = drain.final_deleted_snapshot
+    if final is None:
+        return snapshot
+    pos = {nm: i for i, nm in enumerate(snapshot.node_names)}
+    pbn: List[List[dict]] = [[] for _ in range(snapshot.num_nodes)]
+    for j, nm in enumerate(final.node_names):
+        pbn[pos[nm]] = list(final.pods_by_node[j])
+    changed = [i for i in range(snapshot.num_nodes)
+               if len(pbn[i]) != len(snapshot.pods_by_node[i])
+               or any(a is not b
+                      for a, b in zip(pbn[i], snapshot.pods_by_node[i]))]
+    snap = snapshot_mod.with_pods_by_node(snapshot, pbn, changed)
+    if snap is None:
+        snap = ClusterSnapshot.from_objects(
+            snapshot.nodes, [p for plist in pbn for p in plist],
+            sort_nodes=False,
+            **{k: getattr(snapshot, k) for k in snapshot_mod.OBJECT_FIELDS})
+    return snap
+
+
+def analyze(snapshot: ClusterSnapshot, scenarios: Sequence[FailureScenario],
+            probe: dict, profile: Optional[SchedulerProfile] = None,
+            max_limit: int = 0, mesh=None, dedup: bool = True,
+            keep_placements: bool = False) -> SurvivabilityReport:
+    """Run every failure scenario: drain + re-schedule displaced pods, then
+    measure remaining probe headroom — batched as ONE device solve per
+    problem-shape group when masking is exact, sequential per-scenario
+    deleted-snapshot solves otherwise.
+
+    mesh: optional jax.sharding.Mesh — the batched solve shards the scenario
+    batch axis / node axis over it exactly like parallel/sweep.
+    dedup=False disables symmetric-scenario collapsing (scenarios.py).
+    """
+    profile = profile or SchedulerProfile()
+    scenarios = list(scenarios)
+    n = snapshot.num_nodes
+
+    base_pb = enc.encode_problem(snapshot, probe, profile)
+    baseline = solve_auto(base_pb, max_limit=max_limit)
+
+    dup_of = dedup_single_node(base_pb, scenarios) if dedup else {}
+    rep_set = [si for si in range(len(scenarios)) if si not in dup_of]
+    exact = _mask_exact(base_pb, probe)
+
+    # --- drain phase (host, sequential — only scenarios that lose pods) ----
+    drains: Dict[int, DrainOutcome] = {}
+    for si in rep_set:
+        sc = scenarios[si]
+        if any(snapshot.pods_by_node[i] for i in sc.failed):
+            drains[si] = _drain(snapshot, sc, profile)
+        else:
+            drains[si] = DrainOutcome(0, 0, 0, 0, None)
+
+    # --- headroom phase ----------------------------------------------------
+    headroom: Dict[int, sim.SolveResult] = {}
+    placement_names: Dict[int, List[str]] = {}
+    batched: set = set()
+    batch_pbs: List[enc.EncodedProblem] = []
+    batch_sis: List[int] = []
+    seq_sis: List[int] = []
+    for si in rep_set:
+        if exact:
+            snap_s = _post_drain_full_axis(snapshot, scenarios[si],
+                                           drains[si])
+            batch_pbs.append(enc.encode_problem(
+                snap_s, probe, profile,
+                alive_mask=scenarios[si].alive_mask(n)))
+            batch_sis.append(si)
+        else:
+            seq_sis.append(si)
+
+    if batch_pbs:
+        # one batched device solve per problem-shape group (normally one
+        # group: same probe, same profile, same snapshot geometry)
+        groups: Dict[tuple, List[int]] = {}
+        for bi, pb in enumerate(batch_pbs):
+            key = sweep._group_key(pb, sim.static_config(pb))
+            groups.setdefault(key, []).append(bi)
+        for idxs in groups.values():
+            res = sweep.solve_group([batch_pbs[bi] for bi in idxs],
+                                    max_limit=max_limit, mesh=mesh)
+            for bi, r in zip(idxs, res):
+                si = batch_sis[bi]
+                headroom[si] = r
+                batched.add(si)
+                if keep_placements:
+                    placement_names[si] = [snapshot.node_names[int(i)]
+                                           for i in r.placements]
+
+    for si in seq_sis:
+        sc = scenarios[si]
+        snap_del = drains[si].final_deleted_snapshot
+        if snap_del is None:
+            snap_del = _delete_nodes(snapshot, sc.failed)
+        r = solve_auto(enc.encode_problem(snap_del, probe, profile),
+                       max_limit=max_limit)
+        headroom[si] = r
+        if keep_placements:
+            placement_names[si] = [snap_del.node_names[int(i)]
+                                   for i in r.placements]
+
+    # --- assemble ----------------------------------------------------------
+    results: List[Optional[ScenarioResult]] = [None] * len(scenarios)
+    for si in rep_set:
+        sc, d, r = scenarios[si], drains[si], headroom[si]
+        results[si] = ScenarioResult(
+            name=sc.name, kind=sc.kind, k=sc.k,
+            failed_nodes=[snapshot.node_names[i] for i in sc.failed],
+            displaced=d.displaced, replaced=d.replaced,
+            stranded=d.stranded, preempted=d.preempted,
+            headroom=r.placed_count, fail_message=r.fail_message,
+            batched=si in batched,
+            probe_placements=placement_names.get(si))
+    for si, rep in dup_of.items():
+        sc, rr = scenarios[si], results[rep]
+        # metrics are permutation-invariant between indistinguishable twins;
+        # placements are not (the argmax tie-break rotates) — drop them
+        results[si] = dataclasses.replace(
+            rr, name=sc.name,
+            failed_nodes=[snapshot.node_names[i] for i in sc.failed],
+            deduped_of=rr.name, probe_placements=None)
+
+    return SurvivabilityReport(
+        probe_name=(probe.get("metadata") or {}).get("name", ""),
+        num_nodes=n,
+        baseline_headroom=baseline.placed_count,
+        scenarios=[r for r in results if r is not None],
+        collapsed_scenarios=len(dup_of),
+        batched_scenarios=len(batch_sis),
+        sequential_scenarios=len(seq_sis),
+    )

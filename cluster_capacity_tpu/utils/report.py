@@ -47,6 +47,9 @@ class ClusterCapacityReview:
     creation_timestamp: str
 
     def to_dict(self) -> dict:
+        """Stable machine-readable schema: a {"spec", "status"} envelope —
+        shared with the resilience SurvivabilityReport (resilience/
+        analyzer.py) so every report kind round-trips through from_dict."""
         return {
             "spec": {
                 "templates": self.templates,
@@ -73,6 +76,27 @@ class ClusterCapacityReview:
                 ],
             },
         }
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "ClusterCapacityReview":
+        spec, status = data["spec"], data["status"]
+        fail = status.get("failReason") or {}
+        return cls(
+            templates=list(spec.get("templates") or []),
+            pod_requirements=list(spec.get("podRequirements") or []),
+            replicas=status.get("replicas", 0),
+            fail_type=fail.get("failType", ""),
+            fail_message=fail.get("failMessage", ""),
+            pods=[
+                PodResult(
+                    pod_name=p.get("podName", ""),
+                    replicas_on_nodes=[
+                        ReplicasOnNode(r["nodeName"], r["replicas"])
+                        for r in p.get("replicasOnNodes") or []],
+                    fail_summary=p.get("failSummary"))
+                for p in status.get("pods") or []],
+            creation_timestamp=status.get("creationTimestamp", ""),
+        )
 
 
 def _resource_request(pod: Mapping) -> Dict:
@@ -160,6 +184,66 @@ def print_review(review: ClusterCapacityReview, verbose: bool = False,
     if fmt not in ("", "pretty"):
         raise ValueError(f"output format {fmt!r} not recognized")
     _pretty_print(review, verbose, out)
+
+
+def survivability_from_dict(data: dict):
+    """Parse a resilience survivability report back from its JSON form
+    (the same {"spec", "status"} envelope as the capacity review)."""
+    from ..resilience.analyzer import SurvivabilityReport
+    return SurvivabilityReport.from_dict(data)
+
+
+def print_survivability(report, verbose: bool = False, fmt: str = "",
+                        out=None) -> None:
+    """Survivability report printer: table by default, json/yaml for the
+    machine-readable schema (resilience/analyzer.SurvivabilityReport)."""
+    import sys
+    out = out or sys.stdout
+    if fmt == "json":
+        out.write(json.dumps(report.to_dict()) + "\n")
+        return
+    if fmt == "yaml":
+        out.write(yaml.safe_dump(report.to_dict(), sort_keys=False,
+                                 default_flow_style=False))
+        return
+    if fmt not in ("", "pretty"):
+        raise ValueError(f"output format {fmt!r} not recognized")
+
+    out.write(f"Survivability of probe '{report.probe_name}' on "
+              f"{report.num_nodes} node(s); baseline headroom "
+              f"{report.baseline_headroom}\n")
+    out.write(f"{len(report.scenarios)} scenario(s): "
+              f"{report.collapsed_scenarios} collapsed as symmetric "
+              f"duplicates, {report.batched_scenarios} in one batched "
+              f"device sweep, {report.sequential_scenarios} sequential\n")
+    mk = report.min_k_to_stranded
+    out.write("min k to first stranded pod: "
+              f"{mk if mk is not None else '-'}\n")
+    mk = report.min_k_to_zero_headroom
+    out.write("min k to zero headroom: "
+              f"{mk if mk is not None else '-'}\n\n")
+
+    name_w = max([len("SCENARIO")]
+                 + [len(r.name) for r in report.scenarios])
+    out.write(f"{'SCENARIO':<{name_w}}  {'K':>3}  {'DISPLACED':>9}  "
+              f"{'REPLACED':>8}  {'STRANDED':>8}  {'PREEMPTED':>9}  "
+              f"{'HEADROOM':>8}\n")
+    for r in report.scenarios:
+        out.write(f"{r.name:<{name_w}}  {r.k:>3}  {r.displaced:>9}  "
+                  f"{r.replaced:>8}  {r.stranded:>8}  {r.preempted:>9}  "
+                  f"{r.headroom:>8}\n")
+        if verbose and r.deduped_of:
+            out.write(f"{'':<{name_w}}  (metrics shared with "
+                      f"{r.deduped_of})\n")
+        if verbose and r.fail_message:
+            out.write(f"{'':<{name_w}}  {r.fail_message}\n")
+
+    worst = report.worst_nodes()
+    if worst:
+        out.write("\nWorst nodes (stranded desc, headroom asc):\n")
+        for i, (nm, headroom, stranded) in enumerate(worst, 1):
+            out.write(f"  {i}. {nm}  headroom={headroom}  "
+                      f"stranded={stranded}\n")
 
 
 def _pretty_print(r: ClusterCapacityReview, verbose: bool, out) -> None:

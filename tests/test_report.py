@@ -80,3 +80,38 @@ def test_events_recorded():
     cc.sync_with_objects(nodes, [squatter])
     cc.run()
     assert default_recorder.by_reason("Preempted")
+
+
+def test_review_from_dict_roundtrip():
+    """The {"spec", "status"} envelope is stable: to_dict → from_dict →
+    to_dict is the identity."""
+    from cluster_capacity_tpu.utils.report import ClusterCapacityReview
+    review = _demo().report()
+    d1 = review.to_dict()
+    d2 = ClusterCapacityReview.from_dict(
+        json.loads(json.dumps(d1))).to_dict()
+    assert d1 == d2
+
+
+def test_survivability_roundtrip_shares_envelope():
+    """The resilience report uses the same machine-readable envelope as the
+    capacity review and round-trips through survivability_from_dict —
+    derived fields (worstNodes, headroomCurve, min-k) are recomputed from
+    the scenarios and must come back identical."""
+    from cluster_capacity_tpu.models.snapshot import ClusterSnapshot
+    from cluster_capacity_tpu.resilience import (analyze,
+                                                 single_node_scenarios)
+    from cluster_capacity_tpu.utils.report import (print_survivability,
+                                                   survivability_from_dict)
+    nodes = [build_test_node(f"n{i}", 2000, 4 * 1024 ** 3, 8)
+             for i in range(3)]
+    pods = [build_test_pod("resident", 500, 0, node_name="n0")]
+    snap = ClusterSnapshot.from_objects(nodes, pods)
+    probe = default_pod(build_test_pod("probe", 500, 0))
+    report = analyze(snap, single_node_scenarios(snap), probe,
+                     profile=SchedulerProfile())
+    buf = io.StringIO()
+    print_survivability(report, fmt="json", out=buf)
+    data = json.loads(buf.getvalue())
+    assert set(data) == {"spec", "status"}
+    assert survivability_from_dict(data).to_dict() == data

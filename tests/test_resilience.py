@@ -1,0 +1,531 @@
+"""Resilience subsystem coverage: alive-mask encoding, batched N-k sweeps
+bit-identical to physical node deletion, drain + preemption + PDB interplay
+pinned against sequential reference runs, scenario enumeration, symmetric
+dedup, CLI + report plumbing."""
+
+import copy
+import io
+import json
+
+import numpy as np
+import pytest
+
+from cluster_capacity_tpu import ClusterCapacity, SchedulerProfile
+from cluster_capacity_tpu.engine import encode as enc
+from cluster_capacity_tpu.engine import simulator as sim
+from cluster_capacity_tpu.engine.fast_path import solve_auto
+from cluster_capacity_tpu.models import snapshot as snapshot_mod
+from cluster_capacity_tpu.models.podspec import default_pod
+from cluster_capacity_tpu.models.snapshot import ClusterSnapshot
+from cluster_capacity_tpu.resilience import (FailureScenario, analyze,
+                                             drain_list_scenario,
+                                             random_nk_scenarios,
+                                             single_node_scenarios,
+                                             zone_scenarios)
+from cluster_capacity_tpu.resilience.scenarios import dedup_single_node
+
+from helpers import build_test_node, build_test_pod
+
+
+def _probe(cpu=500, mem=0, name="probe"):
+    return default_pod(build_test_pod(name, cpu, mem))
+
+
+def _delete_solve(snapshot, failed, probe, profile, max_limit=0):
+    """The ground-truth sequential reference: physically delete the failed
+    nodes, keep survivor axis order, solve."""
+    dead = set(failed)
+    keep = [i for i in range(snapshot.num_nodes) if i not in dead]
+    snap = ClusterSnapshot.from_objects(
+        [snapshot.nodes[i] for i in keep],
+        [p for i in keep for p in snapshot.pods_by_node[i]],
+        sort_nodes=False,
+        **{k: getattr(snapshot, k) for k in snapshot_mod.OBJECT_FIELDS})
+    res = solve_auto(enc.encode_problem(snap, probe, profile),
+                     max_limit=max_limit)
+    return res, snap
+
+
+# --- encode-layer alive mask -------------------------------------------------
+
+def test_encode_alive_mask_planes():
+    nodes = [build_test_node(f"n{i}", 2000, 4 * 1024 ** 3, 8)
+             for i in range(4)]
+    snap = ClusterSnapshot.from_objects(nodes)
+    profile = SchedulerProfile()
+    alive = np.array([True, False, True, False])
+    pb = enc.encode_problem(snap, _probe(), profile, alive_mask=alive)
+    assert pb.num_alive == 2
+    assert not pb.static_mask[1] and not pb.static_mask[3]
+    assert pb.static_mask[0] and pb.static_mask[2]
+    assert pb.static_code[1] == enc.CODE_NODE_FAILED
+    assert pb.static_code[3] == enc.CODE_NODE_FAILED
+    # dead nodes drop out of the scan-length bound
+    pb_full = enc.encode_problem(snap, _probe(), profile)
+    assert pb_full.num_alive == 4
+    assert pb.max_steps_hint == pb_full.max_steps_hint // 2
+    # the scan engine places only on survivors and diagnoses the dead ones
+    res = sim.solve(pb)
+    assert set(res.placements) <= {0, 2}
+    assert res.fail_counts.get(enc.REASON_NODE_FAILED) == 2
+
+
+def test_encode_alive_mask_shape_checked():
+    nodes = [build_test_node("n0", 1000, 1024 ** 3, 4)]
+    snap = ClusterSnapshot.from_objects(nodes)
+    with pytest.raises(ValueError):
+        enc.encode_problem(snap, _probe(), SchedulerProfile(),
+                           alive_mask=np.ones(3, dtype=bool))
+
+
+def test_encode_alive_mask_zeroes_static_scores():
+    nodes = [build_test_node(f"n{i}", 2000, 4 * 1024 ** 3, 8,
+                             taints=[{"key": "k", "value": "v",
+                                      "effect": "PreferNoSchedule"}]
+                             if i == 1 else None)
+             for i in range(3)]
+    snap = ClusterSnapshot.from_objects(nodes)
+    pb = enc.encode_problem(snap, _probe(), SchedulerProfile(),
+                            alive_mask=np.array([True, False, True]))
+    # n1's intolerable-taint raw would shift the normalization window —
+    # masked dead, it must read zero like a deleted node's absent row
+    assert pb.taint_raw[1] == 0.0
+
+
+# --- the acceptance criterion ------------------------------------------------
+
+def _heterogeneous_nodes(n, seed):
+    rng = np.random.RandomState(seed)
+    cpus = rng.randint(6, 16, size=n) * 250
+    return [build_test_node(f"node-{i:03d}", int(cpus[i]), 8 * 1024 ** 3, 4,
+                            labels={"topology.kubernetes.io/zone":
+                                    f"z{i % 4}"})
+            for i in range(n)]
+
+
+def test_single_node_128_one_batched_solve_bit_identical():
+    """All 128 single-node-failure scenarios run as ONE batched device solve
+    (one problem-shape group, zero recompiles on a second run) and every
+    per-scenario result is bit-identical to a sequential run that physically
+    deletes the node."""
+    snap = ClusterSnapshot.from_objects(_heterogeneous_nodes(128, seed=3))
+    profile = SchedulerProfile()
+    probe = _probe()
+    scenarios = single_node_scenarios(snap)
+    report = analyze(snap, scenarios, probe, profile=profile, dedup=False,
+                     keep_placements=True)
+    assert report.batched_scenarios == 128
+    assert report.sequential_scenarios == 0
+    assert report.collapsed_scenarios == 0
+    for sc, r in zip(scenarios, report.scenarios):
+        assert r.batched
+        ref, ref_snap = _delete_solve(snap, sc.failed, probe, profile)
+        assert r.headroom == ref.placed_count, sc.name
+        ref_names = [ref_snap.node_names[int(i)] for i in ref.placements]
+        assert r.probe_placements == ref_names, sc.name
+
+    # retrace budget: one compile per static geometry — a second analyze of
+    # the same geometry must hit every cached executable
+    from test_jaxlint import CompileLog
+    with CompileLog() as log:
+        report2 = analyze(snap, scenarios, probe, profile=profile,
+                          dedup=False, keep_placements=True)
+    assert log.compiles == []
+    assert [r.headroom for r in report2.scenarios] == \
+        [r.headroom for r in report.scenarios]
+
+
+def test_masked_batch_matches_deletion_with_drained_pods():
+    """A failed node WITH resident pods: the post-drain state mapped back to
+    the full axis + alive mask must match the sequential deletion path."""
+    nodes = [build_test_node(f"n{i}", 2000, 4 * 1024 ** 3, 6)
+             for i in range(5)]
+    pods = [build_test_pod("p0", 700, 0, node_name="n0"),
+            build_test_pod("p1", 300, 0, node_name="n0")]
+    snap = ClusterSnapshot.from_objects(nodes, pods)
+    profile = SchedulerProfile()
+    probe = _probe()
+    sc = FailureScenario(name="node/n0", kind="node", failed=(0,))
+    report = analyze(snap, [sc], probe, profile=profile,
+                     keep_placements=True)
+    r = report.scenarios[0]
+    assert r.batched and r.displaced == 2 and r.replaced == 2
+    assert r.stranded == 0 and r.preempted == 0
+
+    # sequential reference: delete n0, re-schedule its pods through the
+    # framework run loop in priority order, then measure headroom
+    from cluster_capacity_tpu.resilience.analyzer import _drain
+    outcome = _drain(snap, sc, profile)
+    assert outcome.replaced == 2
+    final = outcome.final_deleted_snapshot
+    ref = solve_auto(enc.encode_problem(final, probe, profile))
+    assert r.headroom == ref.placed_count
+    assert r.probe_placements == \
+        [final.node_names[int(i)] for i in ref.placements]
+
+
+def test_fallback_to_sequential_when_mask_inexact():
+    """A probe with topology spread constraints forces the sequential
+    deleted-snapshot path (masked domains stay countable), and the results
+    still match the reference by construction."""
+    nodes = [build_test_node(f"n{i}", 4000, 8 * 1024 ** 3, 8,
+                             labels={"topology.kubernetes.io/zone":
+                                     f"z{i % 2}"})
+             for i in range(4)]
+    snap = ClusterSnapshot.from_objects(nodes)
+    probe = _probe()
+    probe["spec"]["topologySpreadConstraints"] = [{
+        "maxSkew": 1, "topologyKey": "topology.kubernetes.io/zone",
+        "whenUnsatisfiable": "DoNotSchedule",
+        "labelSelector": {"matchLabels": {"name": "probe"}},
+    }]
+    probe["metadata"]["labels"] = {"name": "probe"}
+    profile = SchedulerProfile()
+    scenarios = single_node_scenarios(snap)
+    report = analyze(snap, scenarios, probe, profile=profile, dedup=False,
+                     keep_placements=True)
+    assert report.batched_scenarios == 0
+    assert report.sequential_scenarios == 4
+    for sc, r in zip(scenarios, report.scenarios):
+        ref, ref_snap = _delete_solve(snap, sc.failed, probe, profile)
+        assert r.headroom == ref.placed_count
+        assert r.probe_placements == \
+            [ref_snap.node_names[int(i)] for i in ref.placements]
+
+
+# --- drain + preemption + PDB interplay (pinned vs sequential reference) ----
+
+def test_drain_displaced_pod_preempts_squatter():
+    """Re-scheduling a displaced high-priority pod must preempt a
+    lower-priority squatter on the survivor."""
+    nodes = [build_test_node("n0", 1000, int(1e9), 10),
+             build_test_node("n1", 1000, int(1e9), 10)]
+    vip = build_test_pod("vip", 800, 0, node_name="n0")
+    vip["spec"]["priority"] = 100
+    squatter = build_test_pod("squatter", 800, 0, node_name="n1")
+    squatter["spec"]["priority"] = 0
+    snap = ClusterSnapshot.from_objects(nodes, [vip, squatter])
+    profile = SchedulerProfile.parity()
+    probe = _probe(cpu=800)
+    sc = FailureScenario(name="node/n0", kind="node", failed=(0,))
+    report = analyze(snap, [sc], probe, profile=profile)
+    r = report.scenarios[0]
+    assert (r.displaced, r.replaced, r.stranded) == (1, 1, 0)
+    assert r.preempted == 1
+    # post-drain n1 holds the vip (800/1000) → no room for an 800m probe
+    assert r.headroom == 0
+
+    # sequential reference: the same drain through the framework directly
+    pending = copy.deepcopy(vip)
+    pending["spec"].pop("nodeName")
+    ref_snap = ClusterSnapshot.from_objects(
+        [nodes[1]], [squatter],
+        **{k: getattr(snap, k) for k in snapshot_mod.OBJECT_FIELDS})
+    cc = ClusterCapacity(pending, max_limit=1, profile=profile)
+    cc.set_snapshot(ref_snap, sort_nodes=False)
+    ref = cc.run()
+    assert ref.placed_count == 1
+    assert list(cc.post_run_snapshot.pods_by_node[0]) == []  # evicted
+    assert r.preempted == sum(len(p) for p in ref_snap.pods_by_node) - \
+        sum(len(p) for p in cc.post_run_snapshot.pods_by_node)
+
+
+def test_drain_pdb_pushes_victim_choice():
+    """PDB-aware drain: with two candidate victims, the zero-disruption PDB
+    pushes eviction to the unprotected node."""
+    nodes = [build_test_node("n0", 1000, int(1e9), 10),
+             build_test_node("protected", 1000, int(1e9), 10),
+             build_test_node("open", 1000, int(1e9), 10)]
+    vip = build_test_pod("vip", 800, 0, node_name="n0")
+    vip["spec"]["priority"] = 100
+    guarded = build_test_pod("guarded", 800, 0, node_name="protected",
+                             labels={"app": "guarded"})
+    guarded["spec"]["priority"] = 0
+    plain = build_test_pod("plain", 800, 0, node_name="open")
+    plain["spec"]["priority"] = 0
+    pdb = {"metadata": {"name": "pdb", "namespace": "default"},
+           "spec": {"selector": {"matchLabels": {"app": "guarded"}}},
+           "status": {"disruptionsAllowed": 0}}
+    snap = ClusterSnapshot.from_objects(nodes, [vip, guarded, plain],
+                                        pdbs=[pdb])
+    profile = SchedulerProfile.parity()
+    sc = FailureScenario(name="node/n0", kind="node", failed=(0,))
+    report = analyze(snap, [sc], _probe(cpu=800), profile=profile,
+                     keep_placements=True)
+    r = report.scenarios[0]
+    assert (r.displaced, r.replaced, r.stranded, r.preempted) == (1, 1, 0, 1)
+    # the guarded squatter survived; 'plain' was the victim, so the drained
+    # vip sits on 'open' and the only probe headroom is on 'protected'... no:
+    # protected still holds guarded (800/1000) → probe can't fit anywhere
+    assert r.headroom == 0
+    from cluster_capacity_tpu.resilience.analyzer import _drain
+    outcome = _drain(snap, sc, profile)
+    final = outcome.final_deleted_snapshot
+    rosters = {final.node_names[i]: [p["metadata"]["name"] for p in plist]
+               for i, plist in enumerate(final.pods_by_node)}
+    assert rosters["protected"] == ["guarded"]
+    assert rosters["open"] == ["vip"]
+
+
+def test_drain_pdb_unreprievable_victim_still_evicted():
+    """PDB-violating victims get reprieve attempts FIRST, but when adding
+    the protected pod back breaks the fit it stays a victim — PDBs are
+    best-effort (preemption.go: they influence choice, never veto)."""
+    nodes = [build_test_node("n0", 1000, int(1e9), 10),
+             build_test_node("n1", 1000, int(1e9), 10)]
+    vip = build_test_pod("vip", 700, 0, node_name="n0")
+    vip["spec"]["priority"] = 100
+    guarded = build_test_pod("guarded", 500, 0, node_name="n1",
+                             labels={"app": "guarded"})
+    guarded["spec"]["priority"] = 0
+    small = build_test_pod("small", 300, 0, node_name="n1")
+    small["spec"]["priority"] = 0
+    pdb = {"metadata": {"name": "pdb", "namespace": "default"},
+           "spec": {"selector": {"matchLabels": {"app": "guarded"}}},
+           "status": {"disruptionsAllowed": 0}}
+    snap = ClusterSnapshot.from_objects(nodes, [vip, guarded, small],
+                                        pdbs=[pdb])
+    profile = SchedulerProfile.parity()
+    sc = FailureScenario(name="node/n0", kind="node", failed=(0,))
+    report = analyze(snap, [sc], _probe(cpu=700), profile=profile)
+    r = report.scenarios[0]
+    # reprieving guarded (500m) over vip (700m) would need 1200m > 1000m →
+    # guarded is unreprievable and is evicted despite its PDB; small (300m)
+    # IS reprieved (300 + 700 fits)
+    assert (r.displaced, r.replaced, r.stranded, r.preempted) == (1, 1, 0, 1)
+    from cluster_capacity_tpu.resilience.analyzer import _drain
+    final = _drain(snap, sc, profile).final_deleted_snapshot
+    names = sorted(p["metadata"]["name"] for p in final.pods_by_node[0])
+    assert names == ["small", "vip"]
+
+
+def test_drain_stranded_counts_and_order():
+    """Displaced pods re-queue highest-priority-first: the high-priority pod
+    takes the last survivor slot, the low-priority one strands."""
+    nodes = [build_test_node("n0", 2000, int(4e9), 10),
+             build_test_node("n1", 1000, int(4e9), 10)]
+    lo = build_test_pod("lo", 800, 0, node_name="n0")
+    lo["spec"]["priority"] = 1
+    hi = build_test_pod("hi", 800, 0, node_name="n0")
+    hi["spec"]["priority"] = 50
+    snap = ClusterSnapshot.from_objects(nodes, [lo, hi])
+    profile = SchedulerProfile.parity()
+    sc = FailureScenario(name="node/n0", kind="node", failed=(0,))
+    r = analyze(snap, [sc], _probe(cpu=800), profile=profile).scenarios[0]
+    assert (r.displaced, r.replaced, r.stranded) == (2, 1, 1)
+    assert r.preempted == 0
+    assert r.headroom == 0
+    # the survivor hosts hi, not lo
+    from cluster_capacity_tpu.resilience.analyzer import _drain
+    final = _drain(snap, sc, profile).final_deleted_snapshot
+    assert [p["metadata"]["name"] for p in final.pods_by_node[0]] == ["hi"]
+
+
+# --- scenario enumeration ----------------------------------------------------
+
+def test_zone_scenarios_and_min_k():
+    nodes = [build_test_node(f"n{i}", 2000, 4 * 1024 ** 3, 4,
+                             labels={"topology.kubernetes.io/zone":
+                                     f"z{i % 3}"})
+             for i in range(9)]
+    snap = ClusterSnapshot.from_objects(nodes)
+    zones = zone_scenarios(snap)
+    assert [z.name for z in zones] == ["zone/z0", "zone/z1", "zone/z2"]
+    assert all(z.k == 3 for z in zones)
+    assert zones[0].failed == (0, 3, 6)
+    probe = _probe()
+    profile = SchedulerProfile()
+    report = analyze(snap, zones, probe, profile=profile,
+                     keep_placements=True)
+    for z, r in zip(zones, report.scenarios):
+        ref, ref_snap = _delete_solve(snap, z.failed, probe, profile)
+        assert r.headroom == ref.placed_count
+        assert r.probe_placements == \
+            [ref_snap.node_names[int(i)] for i in ref.placements]
+    assert report.min_k_to_stranded is None
+    curve = report.headroom_curve()
+    assert [k for k, _, _ in curve] == [3, 3, 3]
+
+
+def test_zone_scenarios_skip_unlabeled_nodes():
+    nodes = [build_test_node("a", 1000, 1024 ** 3, 4,
+                             labels={"topology.kubernetes.io/zone": "z0"}),
+             build_test_node("b", 1000, 1024 ** 3, 4)]
+    snap = ClusterSnapshot.from_objects(nodes)
+    zones = zone_scenarios(snap)
+    assert len(zones) == 1 and zones[0].failed == (0,)
+
+
+def test_random_nk_deterministic_and_distinct():
+    nodes = [build_test_node(f"n{i}", 1000, 1024 ** 3, 4) for i in range(8)]
+    snap = ClusterSnapshot.from_objects(nodes)
+    a = random_nk_scenarios(snap, 3, 5, seed=7)
+    b = random_nk_scenarios(snap, 3, 5, seed=7)
+    assert [s.failed for s in a] == [s.failed for s in b]
+    assert len({s.failed for s in a}) == 5
+    assert all(len(s.failed) == 3 for s in a)
+    with pytest.raises(ValueError):
+        random_nk_scenarios(snap, 9, 1)
+    # subset space smaller than the sample budget: C(2,1) = 2 < 5
+    tiny = ClusterSnapshot.from_objects(
+        [build_test_node(f"n{i}", 1000, 1024 ** 3, 4) for i in range(2)])
+    assert len(random_nk_scenarios(tiny, 1, 5)) == 2
+
+
+def test_drain_list_scenario_validation():
+    nodes = [build_test_node(f"n{i}", 1000, 1024 ** 3, 4) for i in range(3)]
+    snap = ClusterSnapshot.from_objects(nodes)
+    sc = drain_list_scenario(snap, ["n2", "n0"])
+    assert sc.failed == (0, 2) and sc.kind == "drain"
+    with pytest.raises(ValueError, match="unknown node"):
+        drain_list_scenario(snap, ["n0", "ghost"])
+
+
+# --- symmetric-scenario dedup ------------------------------------------------
+
+def test_dedup_collapses_identical_empty_nodes():
+    nodes = [build_test_node(f"twin-{i}", 2000, 4 * 1024 ** 3, 6)
+             for i in range(6)]
+    nodes.append(build_test_node("odd", 4000, 8 * 1024 ** 3, 6))
+    snap = ClusterSnapshot.from_objects(nodes)
+    probe = _probe()
+    profile = SchedulerProfile()
+    scenarios = single_node_scenarios(snap)
+    report = analyze(snap, scenarios, probe, profile=profile)
+    assert report.collapsed_scenarios == 5
+    assert report.batched_scenarios == 2
+    by_name = {r.name: r for r in report.scenarios}
+    rep = by_name["node/twin-0"]
+    assert rep.deduped_of is None
+    for i in range(1, 6):
+        dup = by_name[f"node/twin-{i}"]
+        assert dup.deduped_of == "node/twin-0"
+        assert dup.headroom == rep.headroom
+        assert dup.failed_nodes == [f"twin-{i}"]
+    assert by_name["node/odd"].deduped_of is None
+    # dedup=False solves every scenario and agrees
+    full = analyze(snap, scenarios, probe, profile=profile, dedup=False)
+    assert [r.headroom for r in full.scenarios] == \
+        [r.headroom for r in report.scenarios]
+    assert full.collapsed_scenarios == 0
+
+
+def test_dedup_skips_nodes_with_pods():
+    nodes = [build_test_node(f"twin-{i}", 2000, 4 * 1024 ** 3, 6)
+             for i in range(2)]
+    # identical pods on both twins: the encoded planes still match, but the
+    # displaced pod OBJECTS differ → never collapse
+    pods = [build_test_pod("pa", 500, 0, node_name="twin-0"),
+            build_test_pod("pb", 500, 0, node_name="twin-1")]
+    snap = ClusterSnapshot.from_objects(nodes, pods)
+    pb = enc.encode_problem(snap, _probe(), SchedulerProfile())
+    assert dedup_single_node(pb, single_node_scenarios(snap)) == {}
+
+
+def test_dedup_separates_different_planes():
+    nodes = [build_test_node("a", 2000, 4 * 1024 ** 3, 6),
+             build_test_node("b", 2000, 4 * 1024 ** 3, 6,
+                             taints=[{"key": "k", "value": "v",
+                                      "effect": "NoSchedule"}])]
+    snap = ClusterSnapshot.from_objects(nodes)
+    pb = enc.encode_problem(snap, _probe(), SchedulerProfile())
+    assert dedup_single_node(pb, single_node_scenarios(snap)) == {}
+
+
+# --- mesh pass-through -------------------------------------------------------
+
+def test_analyze_with_mesh_matches():
+    import jax
+    from jax.sharding import Mesh
+    devs = np.array(jax.devices()[:1]).reshape(1, 1)
+    mesh = Mesh(devs, ("batch", "nodes"))
+    nodes = _heterogeneous_nodes(8, seed=5)
+    snap = ClusterSnapshot.from_objects(nodes)
+    probe = _probe()
+    profile = SchedulerProfile()
+    scenarios = single_node_scenarios(snap)
+    plain = analyze(snap, scenarios, probe, profile=profile, dedup=False)
+    meshed = analyze(snap, scenarios, probe, profile=profile, dedup=False,
+                     mesh=mesh)
+    assert [r.headroom for r in meshed.scenarios] == \
+        [r.headroom for r in plain.scenarios]
+
+
+# --- report + CLI ------------------------------------------------------------
+
+def test_survivability_report_fields_and_worst_nodes():
+    nodes = [build_test_node("big", 4000, 8 * 1024 ** 3, 8),
+             build_test_node("small", 1000, 1024 ** 3, 8)]
+    pods = [build_test_pod("p", 1100, 0, node_name="big")]
+    snap = ClusterSnapshot.from_objects(nodes, pods)
+    report = analyze(snap, single_node_scenarios(snap), _probe(cpu=900),
+                     profile=SchedulerProfile.parity())
+    by_name = {r.name: r for r in report.scenarios}
+    # big fails → p displaced, can't fit on small (1100 > 1000) → stranded
+    assert by_name["node/big"].stranded == 1
+    assert report.min_k_to_stranded == 1
+    worst = report.worst_nodes()
+    assert worst[0][0] == "big"
+
+
+def test_cli_resilience_json(tmp_path, capsys):
+    from cluster_capacity_tpu.cli import hypercc
+    snap_file = tmp_path / "snap.yaml"
+    snap_file.write_text(json.dumps({
+        "nodes": [
+            {"metadata": {"name": f"n{i}",
+                          "labels": {"topology.kubernetes.io/zone":
+                                     f"z{i % 2}"}},
+             "status": {"allocatable": {"cpu": "2", "memory": "4Gi",
+                                        "pods": "8"}}}
+            for i in range(4)],
+    }))
+    rc = hypercc.run(["resilience", "--snapshot", str(snap_file),
+                      "--zones", "--random-k", "2", "--samples", "2",
+                      "--drain", "n0,n1", "-o", "json"])
+    assert rc == 0
+    data = json.loads(capsys.readouterr().out)
+    assert set(data) == {"spec", "status"}
+    names = [s["name"] for s in data["status"]["scenarios"]]
+    assert "zone/z0" in names and "drain/n0,n1" in names
+    assert any(n.startswith("random-2/") for n in names)
+    assert not any(n.startswith("node/") for n in names)  # explicit modes
+    # default mode: single-node scenarios
+    rc = hypercc.run(["resilience", "--snapshot", str(snap_file),
+                      "-o", "json"])
+    assert rc == 0
+    data = json.loads(capsys.readouterr().out)
+    assert [s["kind"] for s in data["status"]["scenarios"]] == ["node"] * 4
+
+
+def test_cli_resilience_errors(tmp_path, capsys):
+    from cluster_capacity_tpu.cli import resilience as res_cli
+    assert res_cli.run([]) == 1
+    snap_file = tmp_path / "snap.yaml"
+    snap_file.write_text(json.dumps({
+        "nodes": [{"metadata": {"name": "n0"},
+                   "status": {"allocatable": {"cpu": "1", "memory": "1Gi",
+                                              "pods": "4"}}}]}))
+    assert res_cli.run(["--snapshot", str(snap_file),
+                        "--drain", "ghost"]) == 1
+    assert res_cli.run(["--snapshot", str(snap_file), "-o", "bogus"]) == 1
+    assert res_cli.run(["--snapshot", str(snap_file),
+                        "--random-k", "5"]) == 1  # k > num_nodes
+    capsys.readouterr()
+
+
+def test_print_survivability_table(capsys):
+    nodes = [build_test_node(f"n{i}", 2000, 4 * 1024 ** 3, 4)
+             for i in range(3)]
+    snap = ClusterSnapshot.from_objects(nodes)
+    report = analyze(snap, single_node_scenarios(snap), _probe(),
+                     profile=SchedulerProfile())
+    from cluster_capacity_tpu.utils.report import print_survivability
+    buf = io.StringIO()
+    print_survivability(report, verbose=True, out=buf)
+    text = buf.getvalue()
+    assert "SCENARIO" in text and "HEADROOM" in text
+    assert "collapsed as symmetric duplicates" in text
+    assert "Worst nodes" in text
+    with pytest.raises(ValueError):
+        print_survivability(report, fmt="xml")

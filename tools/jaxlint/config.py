@@ -17,6 +17,7 @@ HOT_DIR_PREFIXES = (
     "cluster_capacity_tpu/engine/",
     "cluster_capacity_tpu/parallel/",
     "cluster_capacity_tpu/ops/",
+    "cluster_capacity_tpu/resilience/",
 )
 
 # Function qualnames allowed to synchronize with the device.  A sync call
@@ -41,10 +42,13 @@ SYNC_QUALNAMES = {
     "call_and_unpack",
     # parallel/sweep.py + interleave.py: batched drivers' final readbacks
     "_batched_solve",
+    "solve_group",
     "sweep",
     "solve_interleaved",
     "solve_interleaved_tensor",
     "_drain",
+    # resilience/analyzer.py: scenario driver — drains between device solves
+    "analyze",
 }
 
 # Default baseline location, relative to the repo root.
