@@ -7,7 +7,7 @@ CXX ?= g++
 CXXFLAGS ?= -O2 -std=c++17 -fPIC -Wall
 NATIVE_LIB := cluster_capacity_tpu/models/libccsnap.so
 
-.PHONY: all build native lint test-unit test-parity test-fuzz test-dist test-integration test-e2e bench clean verify-native ci
+.PHONY: all build native lint test-unit test-parity test-fuzz test-dist test-integration test-e2e bench chaos clean verify-native ci
 
 all: build
 
@@ -38,6 +38,12 @@ test-parity:
 # Full differential fuzz: 200 mixed-family seeds + 60 fused-kernel seeds.
 test-fuzz:
 	$(PY) -m pytest tests/test_fuzz.py tests/test_fused.py -m fuzz -q
+
+# Chaos suite: deterministic fault injection into every device dispatch
+# site; each injected OOM/hang/corruption must degrade down the runtime
+# ladder to a bit-identical result (runtime/, tests/test_runtime.py).
+chaos:
+	JAX_PLATFORM_NAME=cpu $(PY) -m pytest tests/test_runtime.py -q
 
 # Multi-host DCN proof: 2 CPU processes over one 8-device mesh.
 test-dist:

@@ -93,6 +93,17 @@ def build_parser(prog: str = "cluster-capacity") -> argparse.ArgumentParser:
                         "that tests/test_golden_scenarios.py replays and a "
                         "kube-scheduler machine can re-record verbatim. "
                         "Single --podspec, --snapshot runs only.")
+    p.add_argument("--inject-fault", dest="inject_fault", action="append",
+                   default=[], metavar="SITE:KIND[:AT[:TIMES]]",
+                   help="Chaos testing: inject a deterministic fault at a "
+                        "runtime dispatch site (runtime/faults.py), e.g. "
+                        "engine.solve:oom or parallel.solve_group:hang:2. "
+                        "May be repeated; the CC_INJECT_FAULT env var takes "
+                        "the same comma-separated specs.")
+    p.add_argument("--strict", action="store_true",
+                   help="Exit nonzero (status 3) when any solve was served "
+                        "by a degraded ladder rung instead of the healthy "
+                        "device path.")
     p.add_argument("--interleave", action="store_true",
                    help="With multiple --podspec: race the templates through "
                         "ONE shared cluster state with scheduling-queue pop "
@@ -141,6 +152,14 @@ def run(argv: Optional[List[str]] = None, prog: str = "cluster-capacity") -> int
         print(f"Error: output format {args.output!r} not recognized",
               file=sys.stderr)
         return 1
+
+    if args.inject_fault:
+        from ..runtime import faults
+        try:
+            faults.install_text(args.inject_fault)
+        except ValueError as e:
+            print(f"Error: {e}", file=sys.stderr)
+            return 1
 
     pods = []
     for spec_path in args.podspec:
@@ -281,9 +300,11 @@ def run(argv: Optional[List[str]] = None, prog: str = "cluster-capacity") -> int
     if args.watch and args.period <= 0:
         args.period = 10.0
     runs = 0
+    any_degraded = False
     while True:
         review = one_run()
         print_review(review, verbose=args.verbose, fmt=args.output)
+        any_degraded = any_degraded or review.degraded
         if args.metrics:
             from ..utils.metrics import default_registry
             sys.stderr.write(default_registry.render())
@@ -294,6 +315,10 @@ def run(argv: Optional[List[str]] = None, prog: str = "cluster-capacity") -> int
             break
         sys.stdout.flush()
         time.sleep(args.period)
+    if args.strict and any_degraded:
+        print("Error: --strict and at least one solve was served by a "
+              "degraded ladder rung", file=sys.stderr)
+        return 3
     return 0
 
 

@@ -76,6 +76,24 @@ def build_parser(prog: str = "resilience") -> argparse.ArgumentParser:
     p.add_argument("--verbose", action="store_true", help="Verbose mode")
     p.add_argument("-o", "--output", default="",
                    help="Output format. One of: json|yaml.")
+    p.add_argument("--journal", default="",
+                   help="Path to a per-scenario result journal: completed "
+                        "scenarios append as they finish, so a killed sweep "
+                        "can continue with --resume instead of restarting.")
+    p.add_argument("--resume", action="store_true",
+                   help="With --journal: skip scenarios already completed "
+                        "in the journal (fingerprint-checked — the probe, "
+                        "node set, limit, and scenario list must match).")
+    p.add_argument("--inject-fault", dest="inject_fault", action="append",
+                   default=[], metavar="SITE:KIND[:AT[:TIMES]]",
+                   help="Chaos testing: inject a deterministic fault at a "
+                        "runtime dispatch site (runtime/faults.py), e.g. "
+                        "parallel.solve_group:oom. May be repeated; the "
+                        "CC_INJECT_FAULT env var takes the same specs.")
+    p.add_argument("--strict", action="store_true",
+                   help="Exit nonzero (status 3) when any scenario was "
+                        "served by a degraded ladder rung instead of the "
+                        "healthy device path.")
     return p
 
 
@@ -94,6 +112,18 @@ def run(argv: Optional[List[str]] = None, prog: str = "resilience") -> int:
         print("Error: --random-k and --samples must be positive",
               file=sys.stderr)
         return 1
+    if args.resume and not args.journal:
+        print("Error: --resume requires --journal PATH",
+              file=sys.stderr)
+        return 1
+
+    if args.inject_fault:
+        from ..runtime import faults
+        try:
+            faults.install_text(args.inject_fault)
+        except ValueError as e:
+            print(f"Error: {e}", file=sys.stderr)
+            return 1
 
     if args.podspec:
         probe = default_pod(parse_pod_text(_read_podspec(args.podspec)))
@@ -143,9 +173,19 @@ def run(argv: Optional[List[str]] = None, prog: str = "resilience") -> int:
               file=sys.stderr)
         return 1
 
-    report = analyze(snapshot, scenarios, probe, profile=profile,
-                     max_limit=args.max_limit, dedup=not args.no_dedup)
+    from ..runtime.errors import CheckpointCorruption
+    try:
+        report = analyze(snapshot, scenarios, probe, profile=profile,
+                         max_limit=args.max_limit, dedup=not args.no_dedup,
+                         journal=args.journal or None, resume=args.resume)
+    except CheckpointCorruption as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
     print_survivability(report, verbose=args.verbose, fmt=args.output)
+    if args.strict and report.degraded:
+        print("Error: --strict and at least one scenario was served by a "
+              "degraded ladder rung", file=sys.stderr)
+        return 3
     return 0
 
 

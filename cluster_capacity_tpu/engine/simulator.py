@@ -184,6 +184,11 @@ class SolveResult:
     fail_message: str
     fail_counts: Dict[str, int] = field(default_factory=dict)
     node_names: List[str] = field(default_factory=list)
+    # Hardened-runtime provenance: which degradation-ladder rung served this
+    # result ('' = unsupervised direct engine call) and whether any
+    # classified fault occurred on the way (runtime/degrade.py).
+    rung: str = ""
+    degraded: bool = False
 
     @property
     def per_node_counts(self) -> Dict[str, int]:

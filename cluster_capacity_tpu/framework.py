@@ -12,7 +12,6 @@ from __future__ import annotations
 from typing import List, Optional, Sequence
 
 from .engine.encode import encode_problem
-from .engine.fast_path import solve_auto
 from .engine.simulator import SolveResult
 from .models.podspec import default_pod, load_pod_yaml, parse_pod_text, validate_pod
 from .models import snapshot as snapshot_mod
@@ -146,10 +145,13 @@ class ClusterCapacity:
         profile = self.profile
         preempt_on = "DefaultPreemption" in profile.post_filters
 
+        from .runtime.degrade import solve_one_guarded, worst_rung
+
         snap = snapshot
         placements: List[int] = []
         clone_seq = 0
         result: Optional[SolveResult] = None
+        cycle_results: List[SolveResult] = []   # rung/degraded provenance
 
         while True:
             with tracer.span(SPAN_SNAPSHOT):
@@ -163,7 +165,8 @@ class ClusterCapacity:
                 result = solve_with_extenders(problem, profile.extenders,
                                               max_limit=remaining)
             else:
-                result = solve_auto(problem, max_limit=remaining)
+                result = solve_one_guarded(problem, max_limit=remaining)
+            cycle_results.append(result)
             placements.extend(result.placements)
             if result.fail_type != "Unschedulable" or not preempt_on:
                 break
@@ -230,8 +233,14 @@ class ClusterCapacity:
 
         self._final_snapshot = snap
         if result is None:
-            result = solve_auto(encode_problem(snapshot, self.pod, profile),
-                                max_limit=self.max_limit)
+            result = solve_one_guarded(
+                encode_problem(snapshot, self.pod, profile),
+                max_limit=self.max_limit)
+            cycle_results.append(result)
+        # a preemption loop spans several solves: the report's provenance is
+        # the WORST rung any cycle fell to, degraded if any cycle was
+        result.degraded = any(r.degraded for r in cycle_results)
+        result.rung = worst_rung(cycle_results)
         if self.max_limit and len(placements) >= self.max_limit:
             result.fail_type = "LimitReached"
             result.fail_message = (f"Maximum number of pods simulated: "

@@ -23,7 +23,8 @@ import numpy as np
 from .podspec import (RES_CPU, RES_EPHEMERAL, RES_MEMORY, RES_PODS,
                       is_scalar_resource_name, pod_host_ports,
                       pod_nonzero_cpu_mem, pod_requests)
-from ..utils.quantity import int_value, milli_value
+from ..runtime.errors import SnapshotValidationError
+from ..utils.quantity import QuantityError, int_value, milli_value
 
 IDX_PODS = 0
 IDX_CPU = 1
@@ -43,11 +44,45 @@ OBJECT_FIELDS = ("services", "pvcs", "pvs", "csinodes", "limit_ranges",
                  "resource_claim_templates", "device_classes")
 
 
-def _parse_allocatable(alloc: Mapping) -> Dict[str, int]:
+def _parse_allocatable(alloc: Mapping,
+                       field_path: str = "") -> Dict[str, int]:
     out: Dict[str, int] = {}
     for name, q in (alloc or {}).items():
-        out[name] = milli_value(q) if name == RES_CPU else int_value(q)
+        try:
+            out[name] = milli_value(q) if name == RES_CPU else int_value(q)
+        except QuantityError as exc:
+            raise SnapshotValidationError(
+                str(exc),
+                field_path=f"{field_path}.{name}" if field_path
+                else str(name)) from exc
     return out
+
+
+def _pod_path(pod, fallback: str) -> str:
+    """pods[<ns>/<name>] when identifiable, else the positional fallback."""
+    try:
+        meta = pod.get("metadata") or {}
+        name = meta.get("name") or ""
+        ns = meta.get("namespace") or "default"
+        if name:
+            return f"pods[{ns}/{name}]"
+    except AttributeError:
+        pass
+    return fallback
+
+
+def _validated_pod_requests(pod, fallback: str) -> Dict[str, int]:
+    path = _pod_path(pod, fallback)
+    try:
+        return pod_requests(pod)
+    except QuantityError as exc:
+        raise SnapshotValidationError(
+            str(exc),
+            field_path=f"{path}.spec.containers.resources.requests") from exc
+    except (AttributeError, TypeError, KeyError, IndexError) as exc:
+        raise SnapshotValidationError(
+            f"malformed pod spec: {type(exc).__name__}: {exc}",
+            field_path=f"{path}.spec") from exc
 
 
 @dataclass
@@ -192,6 +227,16 @@ class ClusterSnapshot:
         The resource-tensor aggregation runs through the native compiler
         (models/native.py, `make native`) when the shared library is built;
         use_native=False forces the pure-Python path."""
+        for i, n in enumerate(nodes):
+            if not isinstance(n, Mapping):
+                raise SnapshotValidationError(
+                    f"node object is {type(n).__name__}, expected a mapping",
+                    field_path=f"nodes[{i}]")
+        for i, p in enumerate(pods):
+            if not isinstance(p, Mapping):
+                raise SnapshotValidationError(
+                    f"pod object is {type(p).__name__}, expected a mapping",
+                    field_path=f"pods[{i}]")
         excluded = set(exclude_nodes)
         node_list = [dict(n) for n in nodes
                      if (n.get("metadata") or {}).get("name") not in excluded]
@@ -253,15 +298,24 @@ class ClusterSnapshot:
         # Resource vocabulary: base + scalars seen in allocatable or requests.
         scalars = set()
         alloc_maps = []
-        for n in node_list:
-            am = _parse_allocatable((n.get("status") or {}).get("allocatable"))
+        for i, n in enumerate(node_list):
+            alloc = (n.get("status") or {}).get("allocatable")
+            if alloc is not None and not isinstance(alloc, Mapping):
+                raise SnapshotValidationError(
+                    f"allocatable is {type(alloc).__name__}, expected a "
+                    f"mapping",
+                    field_path=f"nodes[{i}].status.allocatable")
+            am = _parse_allocatable(
+                alloc, field_path=f"nodes[{i}].status.allocatable")
             alloc_maps.append(am)
             scalars.update(k for k in am if is_scalar_resource_name(k))
         req_maps: List[Dict[str, int]] = []
-        for plist in pods_by_node:
+        for ni, plist in enumerate(pods_by_node):
             agg: Dict[str, int] = {}
-            for pod in plist:
-                for k, v in pod_requests(pod).items():
+            for pi, pod in enumerate(plist):
+                reqs = _validated_pod_requests(
+                    pod, f"nodes[{ni}].pods[{pi}]")
+                for k, v in reqs.items():
                     agg[k] = agg.get(k, 0) + v
             req_maps.append(agg)
             scalars.update(k for k in agg if is_scalar_resource_name(k))
@@ -291,8 +345,14 @@ class ClusterSnapshot:
                 if j is not None:
                     requested[i, j] = v
             requested[i, IDX_PODS] = len(pods_by_node[i])
-            for pod in pods_by_node[i]:
-                cpu, mem = pod_nonzero_cpu_mem(pod)
+            for pi, pod in enumerate(pods_by_node[i]):
+                try:
+                    cpu, mem = pod_nonzero_cpu_mem(pod)
+                except QuantityError as exc:
+                    raise SnapshotValidationError(
+                        str(exc),
+                        field_path=f"{_pod_path(pod, f'nodes[{i}].pods[{pi}]')}"
+                                   f".spec.containers.resources") from exc
                 nonzero[i, 0] += cpu
                 nonzero[i, 1] += mem
 

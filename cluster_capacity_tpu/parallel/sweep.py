@@ -190,29 +190,49 @@ def sweep(snapshot: ClusterSnapshot, templates: Sequence[dict],
         else:
             rest_idx.append(i)
 
+    from ..runtime import degrade, faults, guard
+    from ..runtime.errors import RuntimeFault
+
     for _key, idxs in fp_groups.items():
         if len(idxs) == 1:
             rest_idx.append(idxs[0])
             continue
-        batch = fast_path.solve_fast_batched(
-            [problems[i] for i in idxs], max_limit)
+        try:
+            batch = guard.run(
+                lambda idxs=idxs: fast_path.solve_fast_batched(
+                    [problems[i] for i in idxs], max_limit),
+                site=faults.SITE_FAST_PATH,
+                validate_nodes=snapshot.num_nodes)
+        except RuntimeFault:
+            # batched analytic kernel faulted: the per-template ladder
+            # below serves these, flagged degraded
+            for i in idxs:
+                results[i] = degrade.solve_one_guarded(
+                    problems[i], max_limit=max_limit, degraded=True)
+            continue
         for i, r in zip(idxs, batch):
             if r is None:
                 rest_idx.append(i)        # zero capacity / monotonicity
             else:
                 results[i] = r
 
+    # Batched groups and per-template solves run under the hardened runtime
+    # (runtime/degrade.py): OOM splits a group geometrically, other
+    # classified faults descend the ladder, results carry rung/degraded.
+    from ..runtime import degrade
+
     for cfg_key, idxs in groups.items():
         if len(idxs) == 1:
             rest_idx.append(idxs[0])
             continue
-        batch_results = _batched_solve([problems[i] for i in idxs],
-                                       max_limit=max_limit, mesh=mesh)
+        batch_results = degrade.solve_group_guarded(
+            [problems[i] for i in idxs], max_limit=max_limit, mesh=mesh)
         for i, r in zip(idxs, batch_results):
             results[i] = r
 
     for i in rest_idx:
-        results[i] = fast_path.solve_auto(problems[i], max_limit=max_limit)
+        results[i] = degrade.solve_one_guarded(problems[i],
+                                               max_limit=max_limit)
     if dup_of:
         import dataclasses as _dc
         for i, j in dup_of.items():

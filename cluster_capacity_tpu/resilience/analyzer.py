@@ -30,7 +30,6 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..engine import encode as enc
 from ..engine import simulator as sim
-from ..engine.fast_path import solve_auto
 from ..models import snapshot as snapshot_mod
 from ..models.snapshot import ClusterSnapshot
 from ..ops.priority_sort import sort_pods
@@ -54,6 +53,44 @@ class ScenarioResult:
     batched: bool = False       # solved via the masked batched path
     deduped_of: Optional[str] = None   # metrics copied from this scenario
     probe_placements: Optional[List[str]] = None  # node names, when kept
+    # hardened-runtime provenance (runtime/degrade.py): the ladder rung that
+    # served the headroom solve, and whether any classified fault degraded it
+    rung: str = ""
+    degraded: bool = False
+
+
+def _scenario_to_dict(r: "ScenarioResult") -> dict:
+    """One scenario row of the {"spec","status"} envelope — also the
+    journal payload, so a resumed sweep reconstructs rows losslessly."""
+    out = {"name": r.name, "kind": r.kind, "k": r.k,
+           "failedNodes": list(r.failed_nodes),
+           "displaced": r.displaced, "replaced": r.replaced,
+           "stranded": r.stranded, "preempted": r.preempted,
+           "headroom": r.headroom,
+           "failMessage": r.fail_message,
+           "batched": r.batched,
+           "dedupedOf": r.deduped_of,
+           "rung": r.rung,
+           "degraded": r.degraded}
+    if r.probe_placements is not None:
+        out["probePlacements"] = list(r.probe_placements)
+    return out
+
+
+def _scenario_from_dict(s: dict) -> "ScenarioResult":
+    return ScenarioResult(
+        name=s["name"], kind=s["kind"], k=s["k"],
+        failed_nodes=list(s["failedNodes"]),
+        displaced=s["displaced"], replaced=s["replaced"],
+        stranded=s["stranded"], preempted=s["preempted"],
+        headroom=s["headroom"],
+        fail_message=s.get("failMessage", ""),
+        batched=s.get("batched", False),
+        deduped_of=s.get("dedupedOf"),
+        probe_placements=(list(s["probePlacements"])
+                          if s.get("probePlacements") is not None else None),
+        rung=s.get("rung", ""),
+        degraded=s.get("degraded", False))
 
 
 @dataclass
@@ -99,6 +136,18 @@ class SurvivabilityReport:
         degradation curve an operator reads min-k thresholds from."""
         return sorted((r.k, r.name, r.headroom) for r in self.scenarios)
 
+    @property
+    def degraded(self) -> bool:
+        """True when any scenario was served by a lower ladder rung after a
+        classified fault — the numbers are still bit-identical, but the
+        operator should know the device path misbehaved."""
+        return any(r.degraded for r in self.scenarios)
+
+    @property
+    def worst_rung(self) -> str:
+        from ..runtime.degrade import worst_rung
+        return worst_rung(self.scenarios)
+
     def to_dict(self) -> dict:
         """Stable machine-readable schema: the same {"spec", "status"}
         envelope as utils/report.ClusterCapacityReview.to_dict."""
@@ -115,22 +164,15 @@ class SurvivabilityReport:
                 "sequentialScenarios": self.sequential_scenarios,
                 "minKToStranded": self.min_k_to_stranded,
                 "minKToZeroHeadroom": self.min_k_to_zero_headroom,
+                "degraded": self.degraded,
+                "worstRung": self.worst_rung,
                 "worstNodes": [
                     {"nodeName": nm, "headroom": h, "stranded": s}
                     for nm, h, s in self.worst_nodes()],
                 "headroomCurve": [
                     {"k": k, "name": nm, "headroom": h}
                     for k, nm, h in self.headroom_curve()],
-                "scenarios": [
-                    {"name": r.name, "kind": r.kind, "k": r.k,
-                     "failedNodes": list(r.failed_nodes),
-                     "displaced": r.displaced, "replaced": r.replaced,
-                     "stranded": r.stranded, "preempted": r.preempted,
-                     "headroom": r.headroom,
-                     "failMessage": r.fail_message,
-                     "batched": r.batched,
-                     "dedupedOf": r.deduped_of}
-                    for r in self.scenarios],
+                "scenarios": [_scenario_to_dict(r) for r in self.scenarios],
             },
         }
 
@@ -141,17 +183,8 @@ class SurvivabilityReport:
             probe_name=spec["probe"]["podName"],
             num_nodes=spec["numNodes"],
             baseline_headroom=status["baselineHeadroom"],
-            scenarios=[
-                ScenarioResult(
-                    name=s["name"], kind=s["kind"], k=s["k"],
-                    failed_nodes=list(s["failedNodes"]),
-                    displaced=s["displaced"], replaced=s["replaced"],
-                    stranded=s["stranded"], preempted=s["preempted"],
-                    headroom=s["headroom"],
-                    fail_message=s.get("failMessage", ""),
-                    batched=s.get("batched", False),
-                    deduped_of=s.get("dedupedOf"))
-                for s in status["scenarios"]],
+            scenarios=[_scenario_from_dict(s)
+                       for s in status["scenarios"]],
             collapsed_scenarios=status["collapsedScenarios"],
             batched_scenarios=status["batchedScenarios"],
             sequential_scenarios=status["sequentialScenarios"],
@@ -292,30 +325,83 @@ def _post_drain_full_axis(snapshot: ClusterSnapshot, scenario: FailureScenario,
 def analyze(snapshot: ClusterSnapshot, scenarios: Sequence[FailureScenario],
             probe: dict, profile: Optional[SchedulerProfile] = None,
             max_limit: int = 0, mesh=None, dedup: bool = True,
-            keep_placements: bool = False) -> SurvivabilityReport:
+            keep_placements: bool = False,
+            journal: Optional[str] = None,
+            resume: bool = False) -> SurvivabilityReport:
     """Run every failure scenario: drain + re-schedule displaced pods, then
     measure remaining probe headroom — batched as ONE device solve per
     problem-shape group when masking is exact, sequential per-scenario
-    deleted-snapshot solves otherwise.
+    deleted-snapshot solves otherwise.  Every device solve runs under the
+    hardened runtime (runtime/degrade.py): OOM splits the batch, other
+    classified faults descend the ladder, and each row records the rung
+    that served it.
 
     mesh: optional jax.sharding.Mesh — the batched solve shards the scenario
     batch axis / node axis over it exactly like parallel/sweep.
     dedup=False disables symmetric-scenario collapsing (scenarios.py).
+
+    journal: path to a per-scenario result journal (utils/checkpoint.
+    ScenarioJournal).  Representative scenarios append as they complete;
+    with resume=True an existing journal whose fingerprint matches skips
+    the already-completed scenarios, so a killed sweep continues instead of
+    restarting.  A fingerprint mismatch (different probe/nodes/limit/
+    scenario set) raises CheckpointCorruption.
     """
+    import os
+
+    from ..runtime import degrade
+    from ..runtime.errors import CheckpointCorruption, RuntimeFault
+    from ..utils.checkpoint import ScenarioJournal, scenario_fingerprint
+
     profile = profile or SchedulerProfile()
     scenarios = list(scenarios)
     n = snapshot.num_nodes
 
     base_pb = enc.encode_problem(snapshot, probe, profile)
-    baseline = solve_auto(base_pb, max_limit=max_limit)
+    baseline = degrade.solve_one_guarded(base_pb, max_limit=max_limit)
 
     dup_of = dedup_single_node(base_pb, scenarios) if dedup else {}
     rep_set = [si for si in range(len(scenarios)) if si not in dup_of]
     exact = _mask_exact(base_pb, probe)
 
+    # --- journal / resume --------------------------------------------------
+    jr: Optional[ScenarioJournal] = None
+    loaded: Dict[int, ScenarioResult] = {}
+    if journal:
+        fingerprint = scenario_fingerprint(
+            probe=probe, num_nodes=n, max_limit=max_limit,
+            scenario_names=[sc.name for sc in scenarios],
+            baseline_headroom=baseline.placed_count)
+        jr = ScenarioJournal(journal)
+        if resume and os.path.exists(journal):
+            old_fp, done = jr.read()
+            if old_fp != fingerprint:
+                raise CheckpointCorruption(
+                    f"journal {journal} belongs to a different sweep "
+                    f"(fingerprint mismatch); delete it or drop --resume",
+                    detail={"path": journal, "expected": fingerprint,
+                            "found": old_fp})
+            name_to_si = {scenarios[si].name: si for si in rep_set}
+            for name, payload in done.items():
+                si = name_to_si.get(name)
+                if si is not None:
+                    loaded[si] = _scenario_from_dict(payload)
+            jr.reopen()
+        else:
+            jr.start(fingerprint)
+
+    def _journal(result: ScenarioResult) -> None:
+        if jr is not None:
+            jr.append(result.name, _scenario_to_dict(result))
+
+    results: List[Optional[ScenarioResult]] = [None] * len(scenarios)
+    for si, row in loaded.items():
+        results[si] = row
+    todo = [si for si in rep_set if si not in loaded]
+
     # --- drain phase (host, sequential — only scenarios that lose pods) ----
     drains: Dict[int, DrainOutcome] = {}
-    for si in rep_set:
+    for si in todo:
         sc = scenarios[si]
         if any(snapshot.pods_by_node[i] for i in sc.failed):
             drains[si] = _drain(snapshot, sc, profile)
@@ -329,7 +415,8 @@ def analyze(snapshot: ClusterSnapshot, scenarios: Sequence[FailureScenario],
     batch_pbs: List[enc.EncodedProblem] = []
     batch_sis: List[int] = []
     seq_sis: List[int] = []
-    for si in rep_set:
+    seq_degraded: set = set()
+    for si in todo:
         if exact:
             snap_s = _post_drain_full_axis(snapshot, scenarios[si],
                                            drains[si])
@@ -348,8 +435,19 @@ def analyze(snapshot: ClusterSnapshot, scenarios: Sequence[FailureScenario],
             key = sweep._group_key(pb, sim.static_config(pb))
             groups.setdefault(key, []).append(bi)
         for idxs in groups.values():
-            res = sweep.solve_group([batch_pbs[bi] for bi in idxs],
-                                    max_limit=max_limit, mesh=mesh)
+            try:
+                res = degrade.solve_group_guarded(
+                    [batch_pbs[bi] for bi in idxs],
+                    max_limit=max_limit, mesh=mesh)
+            except RuntimeFault:
+                # masked problems cannot reach the oracle rung (the mask is
+                # folded into the encoding) — the analyzer's own last rung
+                # is the sequential deleted-snapshot path, where the
+                # failure set is expressed by deletion again
+                for bi in idxs:
+                    seq_sis.append(batch_sis[bi])
+                    seq_degraded.add(batch_sis[bi])
+                continue
             for bi, r in zip(idxs, res):
                 si = batch_sis[bi]
                 headroom[si] = r
@@ -363,16 +461,16 @@ def analyze(snapshot: ClusterSnapshot, scenarios: Sequence[FailureScenario],
         snap_del = drains[si].final_deleted_snapshot
         if snap_del is None:
             snap_del = _delete_nodes(snapshot, sc.failed)
-        r = solve_auto(enc.encode_problem(snap_del, probe, profile),
-                       max_limit=max_limit)
+        r = degrade.solve_one_guarded(
+            enc.encode_problem(snap_del, probe, profile),
+            max_limit=max_limit, degraded=si in seq_degraded)
         headroom[si] = r
         if keep_placements:
             placement_names[si] = [snap_del.node_names[int(i)]
                                    for i in r.placements]
 
     # --- assemble ----------------------------------------------------------
-    results: List[Optional[ScenarioResult]] = [None] * len(scenarios)
-    for si in rep_set:
+    for si in todo:
         sc, d, r = scenarios[si], drains[si], headroom[si]
         results[si] = ScenarioResult(
             name=sc.name, kind=sc.kind, k=sc.k,
@@ -381,7 +479,15 @@ def analyze(snapshot: ClusterSnapshot, scenarios: Sequence[FailureScenario],
             stranded=d.stranded, preempted=d.preempted,
             headroom=r.placed_count, fail_message=r.fail_message,
             batched=si in batched,
-            probe_placements=placement_names.get(si))
+            probe_placements=placement_names.get(si),
+            rung=getattr(r, "rung", ""),
+            degraded=getattr(r, "degraded", False))
+    # journal in enumeration order so resume skips a clean prefix
+    for si in rep_set:
+        if si not in loaded:
+            _journal(results[si])
+    if jr is not None:
+        jr.close()
     for si, rep in dup_of.items():
         sc, rr = scenarios[si], results[rep]
         # metrics are permutation-invariant between indistinguishable twins;
@@ -391,12 +497,16 @@ def analyze(snapshot: ClusterSnapshot, scenarios: Sequence[FailureScenario],
             failed_nodes=[snapshot.node_names[i] for i in sc.failed],
             deduped_of=rr.name, probe_placements=None)
 
+    rows = [r for r in results if r is not None]
+    # counts are derived from the rows (not running tallies) so a resumed
+    # sweep reports exactly what an uninterrupted one would
+    reps = [r for r in rows if r.deduped_of is None]
     return SurvivabilityReport(
         probe_name=(probe.get("metadata") or {}).get("name", ""),
         num_nodes=n,
         baseline_headroom=baseline.placed_count,
-        scenarios=[r for r in results if r is not None],
-        collapsed_scenarios=len(dup_of),
-        batched_scenarios=len(batch_sis),
-        sequential_scenarios=len(seq_sis),
+        scenarios=rows,
+        collapsed_scenarios=len(rows) - len(reps),
+        batched_scenarios=sum(1 for r in reps if r.batched),
+        sequential_scenarios=sum(1 for r in reps if not r.batched),
     )

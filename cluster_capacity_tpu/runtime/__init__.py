@@ -1,0 +1,33 @@
+"""Hardened solve runtime: error taxonomy, watchdog guard, degradation
+ladder, and deterministic fault injection.
+
+Production schedulers treat capacity simulation as a service (the reference
+loops until Unschedulable and always emits a report); constraint-packing and
+RL-tuning work calls the oracle thousands of times and assumes it is
+dependable.  This package makes every device solve either succeed, degrade
+gracefully, or resume — never crash with a raw traceback:
+
+- errors.py   structured fault taxonomy (DeviceOOM, CompileTimeout,
+              ExecuteTimeout, NumericCorruption, SnapshotValidationError,
+              CheckpointCorruption)
+- guard.py    the watchdog: wall-clock deadline + XlaRuntimeError
+              classification + output validation around a device call
+- degrade.py  bounded retry with geometric batch splitting on OOM and the
+              degradation ladder fused_batched → fused → fast_path → oracle
+- faults.py   deterministic fault injection (env/config driven) shared by
+              the chaos tests and the CLI --inject-fault flag
+"""
+
+from .errors import (CheckpointCorruption, CompileTimeout, DeviceOOM,
+                     ExecuteTimeout, NumericCorruption, RuntimeFault,
+                     SnapshotValidationError)
+from .degrade import (LADDER, RUNG_BATCHED, RUNG_FAST_PATH, RUNG_FUSED,
+                      RUNG_ORACLE, solve_group_guarded, solve_one_guarded,
+                      worst_rung)
+
+__all__ = [
+    "RuntimeFault", "DeviceOOM", "CompileTimeout", "ExecuteTimeout",
+    "NumericCorruption", "SnapshotValidationError", "CheckpointCorruption",
+    "LADDER", "RUNG_BATCHED", "RUNG_FUSED", "RUNG_FAST_PATH", "RUNG_ORACLE",
+    "solve_one_guarded", "solve_group_guarded", "worst_rung",
+]

@@ -1,0 +1,206 @@
+"""Bounded retry, geometric batch splitting, and the degradation ladder.
+
+Every hardened solve descends a fixed ladder until a rung serves:
+
+    fused_batched  one batched device solve for the whole [B, ...] group
+    fused          the full engine per problem (fast path when exact,
+                   fused-Pallas/XLA scan otherwise — sim.solve semantics)
+    fast_path      the analytic closed-form solve alone (None ⇒ keep falling)
+    oracle         sequential host-side reference simulation
+
+Rung transitions happen ONLY on classified faults (DeviceOOM, Compile/
+ExecuteTimeout, NumericCorruption); anything else propagates raw.  OOM on a
+batched group first splits the group in half and re-dispatches (down to
+B=1) — a [B, N, K] score tensor that misses fitting in HBM by 2x usually
+fits as two halves, and splitting preserves bit-identity because batched
+solves are independent per problem.  Each result records the rung that
+served it (`result.rung`) and whether any fault occurred en route
+(`result.degraded`) so reports can flag degraded numbers; a SolveDegraded
+event is recorded per transition.
+
+Bit-identity: the rungs are proven pairwise-identical by the repo's parity
+suites (fast_path vs scan, oracle vs engine under SchedulerProfile.parity(),
+batched vs per-item), so a degraded result is the SAME numbers served
+slower — never different numbers.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from . import guard
+from .errors import RuntimeFault
+from .faults import SITE_FAST_PATH, SITE_GROUP, SITE_ORACLE, SITE_SOLVE
+
+RUNG_BATCHED = "fused_batched"
+RUNG_FUSED = "fused"
+RUNG_FAST_PATH = "fast_path"
+RUNG_ORACLE = "oracle"
+
+# Ladder order, highest (healthiest) first.
+LADDER = (RUNG_BATCHED, RUNG_FUSED, RUNG_FAST_PATH, RUNG_ORACLE)
+
+EVENT_DEGRADED = "SolveDegraded"
+
+
+def worst_rung(results) -> str:
+    """The lowest rung among a set of results ('' when none are stamped)."""
+    worst = -1
+    for r in results:
+        rung = getattr(r, "rung", "")
+        if rung in LADDER:
+            worst = max(worst, LADDER.index(rung))
+    return LADDER[worst] if worst >= 0 else ""
+
+
+def _stamp(result, rung: str, degraded: bool):
+    if result is not None:
+        result.rung = rung
+        result.degraded = degraded or result.degraded
+    return result
+
+
+def _record(fault: RuntimeFault, next_rung: str) -> None:
+    from ..utils.events import default_recorder
+    default_recorder.eventf(
+        "solve", EVENT_DEGRADED,
+        f"{fault.code} at {fault.site or '?'}: falling back to "
+        f"{next_rung}: {fault}")
+
+
+def _solve_oracle(pb, max_limit: int = 0):
+    """Host-side sequential reference as a SolveResult, reproducing
+    sim.solve's budget semantics and failure messages exactly (the parity
+    contract tests/test_oracle_parity.py pins the placements)."""
+    from ..engine import oracle
+    from ..engine import simulator as sim
+
+    if pb.snapshot.num_nodes == 0:
+        return sim.SolveResult(placements=[], placed_count=0,
+                               fail_type=sim.FAIL_UNSCHEDULABLE,
+                               fail_message="0/0 nodes are available",
+                               node_names=[])
+    if pb.pod_level_reason:
+        n = pb.snapshot.num_nodes
+        return sim.SolveResult(
+            placements=[], placed_count=0,
+            fail_type=pb.pod_level_fail_type,
+            fail_message=f"0/{n} nodes are available: "
+                         f"{pb.pod_level_reason}.",
+            fail_counts={pb.pod_level_reason: n},
+            node_names=pb.snapshot.node_names)
+
+    n = pb.snapshot.num_nodes
+    cap = max_limit if max_limit and max_limit > 0 \
+        else sim._DEFAULT_UNLIMITED_CAP
+    placements, counts = oracle.simulate(
+        pb.snapshot, pb.pod, pb.profile, max_limit=cap)
+    placed = len(placements)
+    if max_limit and placed >= max_limit:
+        return sim.SolveResult(
+            placements=placements, placed_count=placed,
+            fail_type=sim.FAIL_LIMIT_REACHED,
+            fail_message=f"Maximum number of pods simulated: {max_limit}",
+            node_names=pb.snapshot.node_names)
+    if counts:
+        return sim.SolveResult(
+            placements=placements, placed_count=placed,
+            fail_type=sim.FAIL_UNSCHEDULABLE,
+            fail_message=sim.format_fit_error(n, counts),
+            fail_counts=counts,
+            node_names=pb.snapshot.node_names)
+    return sim.SolveResult(
+        placements=placements, placed_count=placed,
+        fail_type=sim.FAIL_LIMIT_REACHED,
+        fail_message=(f"Simulation step budget exhausted after {placed} "
+                      f"placements; set max_limit to bound unlimited "
+                      f"profiles"),
+        node_names=pb.snapshot.node_names)
+
+
+def solve_one_guarded(pb, max_limit: int = 0, *, deadline: float = 0.0,
+                      retries: int = 0, degraded: bool = False):
+    """Hardened single-problem solve: full engine → analytic fast path →
+    host oracle.  `retries` re-attempts the SAME rung before descending
+    (transient device errors); `degraded` pre-marks the result when the
+    caller already fell off a higher rung."""
+    from ..engine import fast_path
+
+    n = pb.snapshot.num_nodes
+    masked = pb.num_alive != n
+
+    def _attempt(fn, site, phase):
+        last: Optional[RuntimeFault] = None
+        for _ in range(retries + 1):
+            try:
+                return guard.run(fn, site=site, deadline=deadline,
+                                 phase=phase, validate_nodes=n), None
+            except RuntimeFault as fault:
+                last = fault
+        return None, last
+
+    result, fault = _attempt(
+        lambda: fast_path.solve_auto(pb, max_limit=max_limit),
+        SITE_SOLVE, guard.PHASE_EXECUTE)
+    if fault is None:
+        return _stamp(result, RUNG_FUSED, degraded)
+
+    _record(fault, RUNG_FAST_PATH)
+    result, fp_fault = _attempt(
+        lambda: fast_path.solve_fast(pb, max_limit=max_limit),
+        SITE_FAST_PATH, guard.PHASE_EXECUTE)
+    if fp_fault is None and result is not None:
+        return _stamp(result, RUNG_FAST_PATH, True)
+
+    if masked:
+        # The oracle replays the snapshot and cannot see an alive_mask that
+        # was folded into the encoded problem — callers with masked
+        # problems (resilience sweeps) must fall back at a level where the
+        # mask is still expressible (deleted-snapshot sequential path).
+        raise fault
+    _record(fp_fault or fault, RUNG_ORACLE)
+    result = guard.run(lambda: _solve_oracle(pb, max_limit=max_limit),
+                       site=SITE_ORACLE, validate_nodes=n)
+    return _stamp(result, RUNG_ORACLE, True)
+
+
+def solve_group_guarded(pbs, max_limit: int = 0, mesh=None, *,
+                        deadline: float = 0.0, retries: int = 0,
+                        degraded: bool = False) -> List:
+    """Hardened batched group solve.  DeviceOOM splits the group in half
+    geometrically (independent sub-batches, bit-identical placements) down
+    to B=1; other faults — and B=1 OOM — descend to the per-item ladder."""
+    from ..parallel import sweep as sweep_mod
+
+    if not pbs:
+        return []
+    n = pbs[0].snapshot.num_nodes
+
+    last: Optional[RuntimeFault] = None
+    for _ in range(retries + 1):
+        try:
+            results = guard.run(
+                lambda: sweep_mod.solve_group(pbs, max_limit=max_limit,
+                                              mesh=mesh),
+                site=SITE_GROUP, deadline=deadline,
+                phase=guard.PHASE_COMPILE, validate_nodes=n)
+            return [_stamp(r, RUNG_BATCHED, degraded) for r in results]
+        except RuntimeFault as fault:
+            last = fault
+
+    from .errors import DeviceOOM
+    if isinstance(last, DeviceOOM) and len(pbs) > 1:
+        mid = len(pbs) // 2
+        _record(last, f"{RUNG_BATCHED}[{mid}+{len(pbs) - mid}]")
+        left = solve_group_guarded(pbs[:mid], max_limit=max_limit, mesh=mesh,
+                                   deadline=deadline, retries=retries,
+                                   degraded=True)
+        right = solve_group_guarded(pbs[mid:], max_limit=max_limit,
+                                    mesh=mesh, deadline=deadline,
+                                    retries=retries, degraded=True)
+        return left + right
+
+    _record(last, RUNG_FUSED)
+    return [solve_one_guarded(pb, max_limit=max_limit, deadline=deadline,
+                              retries=retries, degraded=True)
+            for pb in pbs]

@@ -1,0 +1,144 @@
+"""The watchdog: deadline + classification + validation around device calls.
+
+`run()` is the single choke point every hardened dispatch goes through.  It
+
+1. asks the fault harness whether an injected fault fires at this site,
+2. executes the callable — under a wall-clock deadline when one is set,
+3. classifies device-level exceptions into the RuntimeFault taxonomy
+   (anything unclassified propagates raw: an INVALID_ARGUMENT is an engine
+   bug, and degrading would hide it), and
+4. applies injected output corruption, then validates the result planes.
+
+Deadline mechanics: JAX dispatch cannot be interrupted from Python, so the
+call runs in a daemon thread and on timeout the thread is *abandoned* — it
+may still complete in the background, but its result is discarded and the
+supervisor moves down the ladder.  That is the standard watchdog trade-off;
+the alternative (no deadline) wedges the whole sweep on one pathological
+compile.  Deadlines default to off (0) so the healthy path adds no thread
+hop.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterable, Optional
+
+from . import faults
+from .errors import (CompileTimeout, DeviceOOM, ExecuteTimeout,
+                     NumericCorruption)
+
+PHASE_COMPILE = "compile"
+PHASE_EXECUTE = "execute"
+
+# Substrings of XLA status messages that identify an allocation failure.
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "OOM")
+_DEADLINE_MARKERS = ("DEADLINE_EXCEEDED",)
+
+# Exception type names treated as device-level errors.  jaxlib's
+# XlaRuntimeError is matched by name so this module never imports jaxlib
+# directly (the class moved between jaxlib versions); SimulatedDeviceError
+# is the chaos harness's stand-in and goes through the same branch.
+_DEVICE_ERROR_NAMES = frozenset({"XlaRuntimeError", "SimulatedDeviceError"})
+
+
+def is_device_error(exc: BaseException) -> bool:
+    if isinstance(exc, MemoryError):
+        return True
+    return any(t.__name__ in _DEVICE_ERROR_NAMES
+               for t in type(exc).__mro__)
+
+
+def classify_device_error(exc: BaseException, *,
+                          site: str = "",
+                          phase: str = PHASE_EXECUTE):
+    """Map a device-level exception onto the taxonomy, or return None when
+    it is not one we know how to recover from."""
+    if isinstance(exc, MemoryError):
+        return DeviceOOM(str(exc) or "host MemoryError", site=site)
+    if not is_device_error(exc):
+        return None
+    message = str(exc)
+    if any(marker in message for marker in _OOM_MARKERS):
+        return DeviceOOM(message, site=site)
+    if any(marker in message for marker in _DEADLINE_MARKERS):
+        fault = CompileTimeout if phase == PHASE_COMPILE else ExecuteTimeout
+        return fault(message, site=site)
+    return None
+
+
+def validate_result(result, num_nodes: int, *, site: str = "") -> None:
+    """Reject solve outputs that cannot be valid.  Raises NumericCorruption;
+    O(len(placements)) so the healthy path barely notices."""
+    if result is None:
+        return
+    placements = result.placements
+    if result.placed_count != len(placements) or result.placed_count < 0:
+        raise NumericCorruption(
+            f"placed_count={result.placed_count} disagrees with "
+            f"{len(placements)} placements", site=site)
+    for idx in placements:
+        if not (0 <= idx < num_nodes):
+            raise NumericCorruption(
+                f"placement index {idx} outside [0, {num_nodes})", site=site)
+    for reason, count in result.fail_counts.items():
+        if count != count or count < 0:  # NaN or negative
+            raise NumericCorruption(
+                f"fail_counts[{reason!r}] = {count} is not a valid count",
+                site=site)
+
+
+def _deadline_call(fn, args, kwargs, deadline: float, *,
+                   site: str, phase: str):
+    box = {}
+
+    def _target():
+        try:
+            box["result"] = fn(*args, **kwargs)
+        except BaseException as exc:  # re-raised on the caller's thread
+            box["error"] = exc
+
+    thread = threading.Thread(
+        target=_target, name=f"cc-guard-{site}", daemon=True)
+    thread.start()
+    thread.join(deadline)
+    if thread.is_alive():
+        fault = CompileTimeout if phase == PHASE_COMPILE else ExecuteTimeout
+        raise fault(
+            f"device call exceeded {deadline:g}s wall-clock deadline "
+            f"(worker thread abandoned)", site=site)
+    if "error" in box:
+        raise box["error"]
+    return box.get("result")
+
+
+def run(fn, *args, site: str, deadline: float = 0.0,
+        phase: str = PHASE_EXECUTE,
+        validate_nodes: Optional[int] = None, **kwargs):
+    """Execute `fn(*args, **kwargs)` under the watchdog.
+
+    Raises DeviceOOM / CompileTimeout / ExecuteTimeout / NumericCorruption
+    for recoverable faults; anything else propagates untouched.
+    """
+    try:
+        corrupt_spec = faults.fire(site)  # may raise simulated oom/hang
+        if deadline and deadline > 0:
+            result = _deadline_call(fn, args, kwargs, deadline,
+                                    site=site, phase=phase)
+        else:
+            result = fn(*args, **kwargs)
+    except faults.SimulatedHang as exc:
+        fault = CompileTimeout if phase == PHASE_COMPILE else ExecuteTimeout
+        raise fault(str(exc), site=site) from exc
+    except Exception as exc:
+        fault = classify_device_error(exc, site=site, phase=phase)
+        if fault is not None:
+            raise fault from exc
+        raise
+    result = faults.maybe_corrupt(corrupt_spec, result)
+    if validate_nodes is not None:
+        if isinstance(result, (list, tuple)):
+            for item in result:
+                validate_result(item, validate_nodes, site=site)
+        else:
+            validate_result(result, validate_nodes, site=site)
+    return result

@@ -1,54 +1,273 @@
-"""Tensorized-snapshot checkpointing.
+"""Tensorized-snapshot checkpointing and the resumable scenario journal.
 
 The reference has no checkpoint/resume (each run re-snapshots and discards,
 SURVEY.md §5); since the snapshot here IS a set of tensors, explicit save/load
 is a new capability: an .npz bundle with the resource tensors plus the raw
 objects, so repeated what-if sweeps skip both the API sync and the host
-aggregation."""
+aggregation.
+
+Integrity: every bundle embeds a sha256 over its tensors + names + objects;
+`load` verifies it and raises CheckpointCorruption on a truncated, bit-rotted
+or half-written file instead of deserializing garbage.  Bundles written
+before the checksum existed load untouched.
+
+ScenarioJournal is the resume mechanism for resilience sweeps: per-scenario
+results append to a line-oriented journal (one self-checksummed JSON record
+per line) as they complete, so a killed sweep restarts with `--resume` and
+skips finished scenarios.  A line journal rather than rewriting the .npz per
+scenario: appends are O(record) and crash-safe — a kill mid-write loses at
+most the final partial line (tolerated and dropped on load), whereas a zip
+archive's central directory only lands at close, so crashing mid-sweep would
+corrupt the WHOLE journal, which is exactly the failure resume exists for.
+"""
 
 from __future__ import annotations
 
+import hashlib
 import json
-from typing import List
+import os
+import zipfile
+from typing import Dict, List, Optional
 
 import numpy as np
 
 from ..models.snapshot import ClusterSnapshot
+from ..runtime.errors import CheckpointCorruption
 
 from ..models.snapshot import OBJECT_FIELDS as _AUX_FIELDS
 
 _OBJECT_FIELDS = ("nodes",) + tuple(_AUX_FIELDS)
+
+_ARRAY_KEYS = ("allocatable", "requested", "nonzero_requested")
 
 
 def _norm(path: str) -> str:
     return path if path.endswith(".npz") else path + ".npz"
 
 
+def _digest(arrays: Dict[str, np.ndarray], node_names: List[str],
+            resource_names: List[str], objects_json: str) -> str:
+    h = hashlib.sha256()
+    for key in _ARRAY_KEYS:
+        arr = np.ascontiguousarray(arrays[key])
+        h.update(key.encode())
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(arr.tobytes())
+    h.update(json.dumps(node_names).encode())
+    h.update(json.dumps(resource_names).encode())
+    h.update(objects_json.encode())
+    return h.hexdigest()
+
+
 def save(path: str, snapshot: ClusterSnapshot) -> None:
     path = _norm(path)
     objects = {f: getattr(snapshot, f) for f in _OBJECT_FIELDS}
     objects["pods_by_node"] = snapshot.pods_by_node
+    objects_json = json.dumps(objects)
+    arrays = {
+        "allocatable": snapshot.allocatable,
+        "requested": snapshot.requested,
+        "nonzero_requested": snapshot.nonzero_requested,
+    }
     np.savez_compressed(
         path,
-        allocatable=snapshot.allocatable,
-        requested=snapshot.requested,
-        nonzero_requested=snapshot.nonzero_requested,
         node_names=np.asarray(snapshot.node_names, dtype=object),
         resource_names=np.asarray(snapshot.resource_names, dtype=object),
-        objects_json=np.asarray(json.dumps(objects)),
+        objects_json=np.asarray(objects_json),
+        checksum=np.asarray(_digest(arrays, snapshot.node_names,
+                                    snapshot.resource_names, objects_json)),
+        **arrays,
     )
 
 
 def load(path: str) -> ClusterSnapshot:
-    with np.load(_norm(path), allow_pickle=True) as z:
-        objects = json.loads(str(z["objects_json"]))
-        return ClusterSnapshot(
-            nodes=objects["nodes"],
-            node_names=[str(s) for s in z["node_names"]],
-            resource_names=[str(s) for s in z["resource_names"]],
-            allocatable=z["allocatable"],
-            requested=z["requested"],
-            nonzero_requested=z["nonzero_requested"],
-            pods_by_node=objects["pods_by_node"],
-            **{f: objects.get(f, []) for f in _OBJECT_FIELDS if f != "nodes"},
-        )
+    path = _norm(path)
+    try:
+        with np.load(path, allow_pickle=True) as z:
+            members = set(z.files)
+            missing = [k for k in (*_ARRAY_KEYS, "node_names",
+                                   "resource_names", "objects_json")
+                       if k not in members]
+            if missing:
+                raise CheckpointCorruption(
+                    f"checkpoint {path} is missing members "
+                    f"{', '.join(missing)}",
+                    detail={"path": path, "missing": missing})
+            objects_json = str(z["objects_json"])
+            node_names = [str(s) for s in z["node_names"]]
+            resource_names = [str(s) for s in z["resource_names"]]
+            arrays = {k: z[k] for k in _ARRAY_KEYS}
+            if "checksum" in members:   # pre-checksum bundles load untouched
+                want = str(z["checksum"])
+                got = _digest(arrays, node_names, resource_names,
+                              objects_json)
+                if got != want:
+                    raise CheckpointCorruption(
+                        f"checkpoint {path} failed its checksum "
+                        f"(expected {want[:12]}…, computed {got[:12]}…)",
+                        detail={"path": path})
+            objects = json.loads(objects_json)
+    except (OSError, ValueError, KeyError, json.JSONDecodeError, EOFError,
+            zipfile.BadZipFile) as exc:
+        # Truncated/garbled archives surface as BadZipFile, EOFError or
+        # ValueError depending on where the zip breaks; normalize every
+        # unreadable bundle into the structured error.
+        raise CheckpointCorruption(
+            f"checkpoint {path} is unreadable: "
+            f"{type(exc).__name__}: {exc}",
+            detail={"path": path}) from exc
+    return ClusterSnapshot(
+        nodes=objects["nodes"],
+        node_names=node_names,
+        resource_names=resource_names,
+        allocatable=arrays["allocatable"],
+        requested=arrays["requested"],
+        nonzero_requested=arrays["nonzero_requested"],
+        pods_by_node=objects["pods_by_node"],
+        **{f: objects.get(f, []) for f in _OBJECT_FIELDS if f != "nodes"},
+    )
+
+
+# --------------------------------------------------------------------------
+# Resumable scenario journal
+# --------------------------------------------------------------------------
+
+_JOURNAL_VERSION = 1
+
+
+def _line_for(record: dict) -> str:
+    body = json.dumps(record, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(body.encode()).hexdigest() + " " + body + "\n"
+
+
+class ScenarioJournal:
+    """Append-only, per-line-checksummed journal of completed scenarios.
+
+    Line format: ``<sha256hex> <compact-json>``.  The first record is a
+    header carrying a fingerprint of the run configuration (probe, node
+    count, limit, scenario-set hash, baseline headroom); `resume` refuses a
+    journal whose fingerprint disagrees — resuming someone else's sweep
+    would silently mix incompatible results.  A truncated FINAL line is the
+    expected crash artifact and is dropped; a checksum mismatch anywhere
+    earlier means the file was edited or bit-rotted and raises
+    CheckpointCorruption.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._fh = None
+
+    # -- writing -----------------------------------------------------------
+
+    def start(self, fingerprint: dict) -> None:
+        """Begin a fresh journal (truncates any existing file)."""
+        header = {"kind": "header", "version": _JOURNAL_VERSION,
+                  "fingerprint": fingerprint}
+        self._fh = open(self.path, "w", encoding="utf-8")
+        self._fh.write(_line_for(header))
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+
+    def reopen(self) -> None:
+        """Continue appending to an existing (validated) journal."""
+        self._fh = open(self.path, "a", encoding="utf-8")
+
+    def append(self, name: str, payload: dict) -> None:
+        if self._fh is None:
+            raise RuntimeError("journal not started/reopened")
+        self._fh.write(_line_for(
+            {"kind": "scenario", "name": name, "result": payload}))
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- reading -----------------------------------------------------------
+
+    def read(self):
+        """Returns (fingerprint, {scenario_name: payload}).  Tolerates a
+        truncated final line; raises CheckpointCorruption on anything
+        else."""
+        fingerprint: Optional[dict] = None
+        done: Dict[str, dict] = {}
+        try:
+            with open(self.path, "r", encoding="utf-8") as fh:
+                lines = fh.readlines()
+        except OSError as exc:
+            raise CheckpointCorruption(
+                f"journal {self.path} is unreadable: {exc}",
+                detail={"path": self.path}) from exc
+        for i, line in enumerate(lines):
+            is_last = i == len(lines) - 1
+            record = self._parse_line(line, i, tolerate=is_last)
+            if record is None:      # dropped truncated tail
+                break
+            if record.get("kind") == "header":
+                if i != 0:
+                    raise CheckpointCorruption(
+                        f"journal {self.path}: header record at line "
+                        f"{i + 1}", detail={"path": self.path})
+                if record.get("version") != _JOURNAL_VERSION:
+                    raise CheckpointCorruption(
+                        f"journal {self.path}: unsupported version "
+                        f"{record.get('version')}",
+                        detail={"path": self.path})
+                fingerprint = record.get("fingerprint") or {}
+            elif record.get("kind") == "scenario":
+                done[record["name"]] = record["result"]
+        if fingerprint is None:
+            raise CheckpointCorruption(
+                f"journal {self.path} has no header record",
+                detail={"path": self.path})
+        return fingerprint, done
+
+    def _parse_line(self, line: str, index: int, *, tolerate: bool):
+        text = line.rstrip("\n")
+        if not text.strip():
+            return None if tolerate else self._corrupt(index, "empty line")
+        parts = text.split(" ", 1)
+        if len(parts) != 2 or len(parts[0]) != 64:
+            if tolerate and not line.endswith("\n"):
+                return None
+            return self._corrupt(index, "malformed record")
+        digest, body = parts
+        if hashlib.sha256(body.encode()).hexdigest() != digest:
+            if tolerate and not line.endswith("\n"):
+                return None
+            return self._corrupt(index, "checksum mismatch")
+        try:
+            return json.loads(body)
+        except json.JSONDecodeError:
+            if tolerate and not line.endswith("\n"):
+                return None
+            return self._corrupt(index, "invalid JSON payload")
+
+    def _corrupt(self, index: int, why: str):
+        raise CheckpointCorruption(
+            f"journal {self.path}: {why} at line {index + 1}",
+            detail={"path": self.path, "line": index + 1})
+
+
+def scenario_fingerprint(*, probe: dict, num_nodes: int, max_limit: int,
+                         scenario_names: List[str],
+                         baseline_headroom: int) -> dict:
+    """Run-identity fingerprint stored in the journal header.  Scenario
+    names are hashed (a 10k-scenario random sweep should not bloat the
+    header) in order — resume requires the same enumeration."""
+    names_hash = hashlib.sha256(
+        "\x00".join(scenario_names).encode()).hexdigest()
+    probe_hash = hashlib.sha256(
+        json.dumps(probe, sort_keys=True).encode()).hexdigest()
+    return {"probe": probe_hash, "numNodes": int(num_nodes),
+            "maxLimit": int(max_limit), "scenarios": names_hash,
+            "baselineHeadroom": int(baseline_headroom)}

@@ -45,6 +45,12 @@ class ClusterCapacityReview:
     fail_message: str
     pods: List[PodResult]
     creation_timestamp: str
+    # hardened-runtime provenance: True when any solve behind this review
+    # fell off its healthy ladder rung (runtime/degrade.py); `rung` is the
+    # worst rung that served — the numbers are still bit-identical, the
+    # flag tells the operator the device path misbehaved
+    degraded: bool = False
+    rung: str = ""
 
     def to_dict(self) -> dict:
         """Stable machine-readable schema: a {"spec", "status"} envelope —
@@ -59,6 +65,8 @@ class ClusterCapacityReview:
             "status": {
                 "creationTimestamp": self.creation_timestamp,
                 "replicas": self.replicas,
+                "degraded": self.degraded,
+                "rung": self.rung,
                 "failReason": {
                     "failType": self.fail_type,
                     "failMessage": self.fail_message,
@@ -96,6 +104,8 @@ class ClusterCapacityReview:
                     fail_summary=p.get("failSummary"))
                 for p in status.get("pods") or []],
             creation_timestamp=status.get("creationTimestamp", ""),
+            degraded=status.get("degraded", False),
+            rung=status.get("rung", ""),
         )
 
 
@@ -158,6 +168,7 @@ def build_review(templates: List[dict], results) -> ClusterCapacityReview:
         pods.append(pr)
 
     first = results[0]
+    from ..runtime.degrade import worst_rung
     return ClusterCapacityReview(
         templates=[copy.deepcopy(t) for t in templates],
         pod_requirements=reqs,
@@ -166,6 +177,8 @@ def build_review(templates: List[dict], results) -> ClusterCapacityReview:
         fail_message=first.fail_message,
         pods=pods,
         creation_timestamp=datetime.now(timezone.utc).isoformat(),
+        degraded=any(getattr(r, "degraded", False) for r in results),
+        rung=worst_rung(results),
     )
 
 
@@ -209,6 +222,8 @@ def print_survivability(report, verbose: bool = False, fmt: str = "",
     if fmt not in ("", "pretty"):
         raise ValueError(f"output format {fmt!r} not recognized")
 
+    if report.degraded:
+        out.write(_degraded_warning(report.worst_rung))
     out.write(f"Survivability of probe '{report.probe_name}' on "
               f"{report.num_nodes} node(s); baseline headroom "
               f"{report.baseline_headroom}\n")
@@ -232,6 +247,9 @@ def print_survivability(report, verbose: bool = False, fmt: str = "",
         out.write(f"{r.name:<{name_w}}  {r.k:>3}  {r.displaced:>9}  "
                   f"{r.replaced:>8}  {r.stranded:>8}  {r.preempted:>9}  "
                   f"{r.headroom:>8}\n")
+        if r.degraded:
+            out.write(f"{'':<{name_w}}  WARNING: degraded — served by "
+                      f"rung '{r.rung or '?'}'\n")
         if verbose and r.deduped_of:
             out.write(f"{'':<{name_w}}  (metrics shared with "
                       f"{r.deduped_of})\n")
@@ -246,8 +264,17 @@ def print_survivability(report, verbose: bool = False, fmt: str = "",
                       f"stranded={stranded}\n")
 
 
+def _degraded_warning(rung: str) -> str:
+    return (f"WARNING: solve degraded — served by ladder rung "
+            f"'{rung or '?'}' after a classified device fault; results "
+            f"are bit-identical to the healthy path but the device "
+            f"misbehaved (see runtime/degrade.py)\n")
+
+
 def _pretty_print(r: ClusterCapacityReview, verbose: bool, out) -> None:
     """clusterCapacityReviewPrettyPrint (report.go:235-284), wording preserved."""
+    if r.degraded:
+        out.write(_degraded_warning(r.rung))
     if verbose:
         for req in r.pod_requirements:
             out.write(f"{req['podName']} pod requirements:\n")

@@ -18,6 +18,7 @@ HOT_DIR_PREFIXES = (
     "cluster_capacity_tpu/parallel/",
     "cluster_capacity_tpu/ops/",
     "cluster_capacity_tpu/resilience/",
+    "cluster_capacity_tpu/runtime/",
 )
 
 # Function qualnames allowed to synchronize with the device.  A sync call
