@@ -103,7 +103,8 @@ def build_parser(prog: str = "cluster-capacity") -> argparse.ArgumentParser:
     p.add_argument("--strict", action="store_true",
                    help="Exit nonzero (status 3) when any solve was served "
                         "by a degraded ladder rung instead of the healthy "
-                        "device path.")
+                        "device path.  With --watch/--period the loop stops "
+                        "at the first degraded run.")
     p.add_argument("--interleave", action="store_true",
                    help="With multiple --podspec: race the templates through "
                         "ONE shared cluster state with scheduling-queue pop "
@@ -309,6 +310,10 @@ def run(argv: Optional[List[str]] = None, prog: str = "cluster-capacity") -> int
             from ..utils.metrics import default_registry
             sys.stderr.write(default_registry.render())
         runs += 1
+        if args.strict and any_degraded:
+            # --strict must not wait for a watch loop that may never exit:
+            # the first degraded run ends the loop and returns status 3
+            break
         if args.period <= 0:
             break
         if args.period_iterations and runs >= args.period_iterations:

@@ -371,7 +371,8 @@ def analyze(snapshot: ClusterSnapshot, scenarios: Sequence[FailureScenario],
         fingerprint = scenario_fingerprint(
             probe=probe, num_nodes=n, max_limit=max_limit,
             scenario_names=[sc.name for sc in scenarios],
-            baseline_headroom=baseline.placed_count)
+            baseline_headroom=baseline.placed_count,
+            profile=profile, snapshot=snapshot)
         jr = ScenarioJournal(journal)
         if resume and os.path.exists(journal):
             old_fp, done = jr.read()
@@ -399,95 +400,91 @@ def analyze(snapshot: ClusterSnapshot, scenarios: Sequence[FailureScenario],
         results[si] = row
     todo = [si for si in rep_set if si not in loaded]
 
-    # --- drain phase (host, sequential — only scenarios that lose pods) ----
-    drains: Dict[int, DrainOutcome] = {}
-    for si in todo:
-        sc = scenarios[si]
-        if any(snapshot.pods_by_node[i] for i in sc.failed):
-            drains[si] = _drain(snapshot, sc, profile)
-        else:
-            drains[si] = DrainOutcome(0, 0, 0, 0, None)
-
-    # --- headroom phase ----------------------------------------------------
-    headroom: Dict[int, sim.SolveResult] = {}
-    placement_names: Dict[int, List[str]] = {}
-    batched: set = set()
-    batch_pbs: List[enc.EncodedProblem] = []
-    batch_sis: List[int] = []
-    seq_sis: List[int] = []
-    seq_degraded: set = set()
-    for si in todo:
-        if exact:
-            snap_s = _post_drain_full_axis(snapshot, scenarios[si],
-                                           drains[si])
-            batch_pbs.append(enc.encode_problem(
-                snap_s, probe, profile,
-                alive_mask=scenarios[si].alive_mask(n)))
-            batch_sis.append(si)
-        else:
-            seq_sis.append(si)
-
-    if batch_pbs:
-        # one batched device solve per problem-shape group (normally one
-        # group: same probe, same profile, same snapshot geometry)
-        groups: Dict[tuple, List[int]] = {}
-        for bi, pb in enumerate(batch_pbs):
-            key = sweep._group_key(pb, sim.static_config(pb))
-            groups.setdefault(key, []).append(bi)
-        for idxs in groups.values():
-            try:
-                res = degrade.solve_group_guarded(
-                    [batch_pbs[bi] for bi in idxs],
-                    max_limit=max_limit, mesh=mesh)
-            except RuntimeFault:
-                # masked problems cannot reach the oracle rung (the mask is
-                # folded into the encoding) — the analyzer's own last rung
-                # is the sequential deleted-snapshot path, where the
-                # failure set is expressed by deletion again
-                for bi in idxs:
-                    seq_sis.append(batch_sis[bi])
-                    seq_degraded.add(batch_sis[bi])
-                continue
-            for bi, r in zip(idxs, res):
-                si = batch_sis[bi]
-                headroom[si] = r
-                batched.add(si)
-                if keep_placements:
-                    placement_names[si] = [snapshot.node_names[int(i)]
-                                           for i in r.placements]
-
-    for si in seq_sis:
-        sc = scenarios[si]
-        snap_del = drains[si].final_deleted_snapshot
-        if snap_del is None:
-            snap_del = _delete_nodes(snapshot, sc.failed)
-        r = degrade.solve_one_guarded(
-            enc.encode_problem(snap_del, probe, profile),
-            max_limit=max_limit, degraded=si in seq_degraded)
-        headroom[si] = r
-        if keep_placements:
-            placement_names[si] = [snap_del.node_names[int(i)]
-                                   for i in r.placements]
-
-    # --- assemble ----------------------------------------------------------
-    for si in todo:
-        sc, d, r = scenarios[si], drains[si], headroom[si]
-        results[si] = ScenarioResult(
+    def _complete(si: int, r: sim.SolveResult, *, was_batched: bool,
+                  node_names: List[str]) -> None:
+        """Assemble a scenario's row and journal it IMMEDIATELY — a sweep
+        killed after this point resumes past the scenario."""
+        sc, d = scenarios[si], drains[si]
+        row = ScenarioResult(
             name=sc.name, kind=sc.kind, k=sc.k,
             failed_nodes=[snapshot.node_names[i] for i in sc.failed],
             displaced=d.displaced, replaced=d.replaced,
             stranded=d.stranded, preempted=d.preempted,
             headroom=r.placed_count, fail_message=r.fail_message,
-            batched=si in batched,
-            probe_placements=placement_names.get(si),
+            batched=was_batched,
+            probe_placements=([node_names[int(i)] for i in r.placements]
+                              if keep_placements else None),
             rung=getattr(r, "rung", ""),
             degraded=getattr(r, "degraded", False))
-    # journal in enumeration order so resume skips a clean prefix
-    for si in rep_set:
-        if si not in loaded:
-            _journal(results[si])
-    if jr is not None:
-        jr.close()
+        results[si] = row
+        _journal(row)
+
+    try:
+        # --- drain phase (host, sequential — scenarios that lose pods) ----
+        drains: Dict[int, DrainOutcome] = {}
+        for si in todo:
+            sc = scenarios[si]
+            if any(snapshot.pods_by_node[i] for i in sc.failed):
+                drains[si] = _drain(snapshot, sc, profile)
+            else:
+                drains[si] = DrainOutcome(0, 0, 0, 0, None)
+
+        # --- headroom phase ------------------------------------------------
+        batch_pbs: List[enc.EncodedProblem] = []
+        batch_sis: List[int] = []
+        seq_sis: List[int] = []
+        seq_degraded: set = set()
+        for si in todo:
+            if exact:
+                snap_s = _post_drain_full_axis(snapshot, scenarios[si],
+                                               drains[si])
+                batch_pbs.append(enc.encode_problem(
+                    snap_s, probe, profile,
+                    alive_mask=scenarios[si].alive_mask(n)))
+                batch_sis.append(si)
+            else:
+                seq_sis.append(si)
+
+        if batch_pbs:
+            # one batched device solve per problem-shape group (normally one
+            # group: same probe, same profile, same snapshot geometry)
+            groups: Dict[tuple, List[int]] = {}
+            for bi, pb in enumerate(batch_pbs):
+                key = sweep._group_key(pb, sim.static_config(pb))
+                groups.setdefault(key, []).append(bi)
+            for idxs in groups.values():
+                try:
+                    res = degrade.solve_group_guarded(
+                        [batch_pbs[bi] for bi in idxs],
+                        max_limit=max_limit, mesh=mesh)
+                except RuntimeFault:
+                    # masked problems cannot reach the oracle rung (the mask
+                    # is folded into the encoding) — the analyzer's own last
+                    # rung is the sequential deleted-snapshot path, where
+                    # the failure set is expressed by deletion again
+                    for bi in idxs:
+                        seq_sis.append(batch_sis[bi])
+                        seq_degraded.add(batch_sis[bi])
+                    continue
+                for bi, r in zip(idxs, res):
+                    _complete(batch_sis[bi], r, was_batched=True,
+                              node_names=snapshot.node_names)
+
+        for si in seq_sis:
+            sc = scenarios[si]
+            snap_del = drains[si].final_deleted_snapshot
+            if snap_del is None:
+                snap_del = _delete_nodes(snapshot, sc.failed)
+            r = degrade.solve_one_guarded(
+                enc.encode_problem(snap_del, probe, profile),
+                max_limit=max_limit, degraded=si in seq_degraded)
+            _complete(si, r, was_batched=False,
+                      node_names=snap_del.node_names)
+    finally:
+        # an interrupted sweep must still leave a well-formed journal —
+        # everything completed so far has already been appended and fsynced
+        if jr is not None:
+            jr.close()
     for si, rep in dup_of.items():
         sc, rr = scenarios[si], results[rep]
         # metrics are permutation-invariant between indistinguishable twins;

@@ -19,6 +19,11 @@ Kinds:
 - ``corrupt``  leave the call alone and poison its *output* plane (NaN fail
                counts, negative placements) via maybe_corrupt, so validation
                — not the exception path — must catch it.
+- ``error``    raise SimulatedDeviceError with an INTERNAL status the
+               classifier does NOT recognize; the guard must propagate it
+               raw (degrading would hide an engine bug), so chaos tests can
+               prove unclassified errors crash — and interrupt a sweep
+               mid-flight to exercise journal resume.
 
 The healthy path stays free: `fire()` is a dict-lookup early return when
 nothing is installed and the env var is unset.
@@ -37,7 +42,8 @@ ENV_VAR = "CC_INJECT_FAULT"
 KIND_OOM = "oom"
 KIND_HANG = "hang"
 KIND_CORRUPT = "corrupt"
-_KINDS = (KIND_OOM, KIND_HANG, KIND_CORRUPT)
+KIND_ERROR = "error"
+_KINDS = (KIND_OOM, KIND_HANG, KIND_CORRUPT, KIND_ERROR)
 
 # Injection sites: the dispatch boundaries guard.run() passes through.
 SITE_SOLVE = "engine.solve"
@@ -176,6 +182,9 @@ def fire(site: str) -> Optional[FaultSpec]:
             f"(injected at {site})")
     if spec.kind == KIND_HANG:
         raise SimulatedHang(f"injected hang at {site}")
+    if spec.kind == KIND_ERROR:
+        raise SimulatedDeviceError(
+            f"INTERNAL: injected unclassified device error at {site}")
     return spec  # corrupt: handled at the output boundary
 
 

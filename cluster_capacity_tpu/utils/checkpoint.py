@@ -60,8 +60,8 @@ def _digest(arrays: Dict[str, np.ndarray], node_names: List[str],
     return h.hexdigest()
 
 
-def save(path: str, snapshot: ClusterSnapshot) -> None:
-    path = _norm(path)
+def _bundle(snapshot: ClusterSnapshot):
+    """(arrays, objects_json) — the checksummed payload of a bundle."""
     objects = {f: getattr(snapshot, f) for f in _OBJECT_FIELDS}
     objects["pods_by_node"] = snapshot.pods_by_node
     objects_json = json.dumps(objects)
@@ -70,6 +70,21 @@ def save(path: str, snapshot: ClusterSnapshot) -> None:
         "requested": snapshot.requested,
         "nonzero_requested": snapshot.nonzero_requested,
     }
+    return arrays, objects_json
+
+
+def snapshot_digest(snapshot: ClusterSnapshot) -> str:
+    """sha256 over the snapshot's tensors + axis names + raw objects — the
+    same digest `save` embeds as the bundle checksum, usable as a content
+    fingerprint for a live (unsaved) snapshot."""
+    arrays, objects_json = _bundle(snapshot)
+    return _digest(arrays, snapshot.node_names, snapshot.resource_names,
+                   objects_json)
+
+
+def save(path: str, snapshot: ClusterSnapshot) -> None:
+    path = _norm(path)
+    arrays, objects_json = _bundle(snapshot)
     np.savez_compressed(
         path,
         node_names=np.asarray(snapshot.node_names, dtype=object),
@@ -170,7 +185,15 @@ class ScenarioJournal:
         os.fsync(self._fh.fileno())
 
     def reopen(self) -> None:
-        """Continue appending to an existing (validated) journal."""
+        """Continue appending to an existing (validated) journal.  The crash
+        that --resume recovers from may have left a half-written final line
+        (read() tolerates and drops it); truncate the file back to the end
+        of the last valid record first — appending onto the partial tail
+        would weld two records into one mid-file line that every later
+        read() rejects as corruption."""
+        _, _, valid_end = self._scan()
+        with open(self.path, "r+b") as fh:
+            fh.truncate(valid_end)
         self._fh = open(self.path, "a", encoding="utf-8")
 
     def append(self, name: str, payload: dict) -> None:
@@ -198,20 +221,30 @@ class ScenarioJournal:
         """Returns (fingerprint, {scenario_name: payload}).  Tolerates a
         truncated final line; raises CheckpointCorruption on anything
         else."""
+        fingerprint, done, _ = self._scan()
+        return fingerprint, done
+
+    def _scan(self):
+        """(fingerprint, {scenario_name: payload}, valid_end) where
+        valid_end is the byte offset just past the last valid record — the
+        truncation point reopen() uses to discard a half-written tail."""
         fingerprint: Optional[dict] = None
         done: Dict[str, dict] = {}
+        valid_end = 0
         try:
-            with open(self.path, "r", encoding="utf-8") as fh:
-                lines = fh.readlines()
+            with open(self.path, "rb") as fh:
+                raw_lines = fh.read().splitlines(keepends=True)
         except OSError as exc:
             raise CheckpointCorruption(
                 f"journal {self.path} is unreadable: {exc}",
                 detail={"path": self.path}) from exc
-        for i, line in enumerate(lines):
-            is_last = i == len(lines) - 1
+        for i, raw in enumerate(raw_lines):
+            line = raw.decode("utf-8", errors="replace")
+            is_last = i == len(raw_lines) - 1
             record = self._parse_line(line, i, tolerate=is_last)
             if record is None:      # dropped truncated tail
                 break
+            valid_end += len(raw)
             if record.get("kind") == "header":
                 if i != 0:
                     raise CheckpointCorruption(
@@ -229,7 +262,7 @@ class ScenarioJournal:
             raise CheckpointCorruption(
                 f"journal {self.path} has no header record",
                 detail={"path": self.path})
-        return fingerprint, done
+        return fingerprint, done, valid_end
 
     def _parse_line(self, line: str, index: int, *, tolerate: bool):
         text = line.rstrip("\n")
@@ -260,14 +293,34 @@ class ScenarioJournal:
 
 def scenario_fingerprint(*, probe: dict, num_nodes: int, max_limit: int,
                          scenario_names: List[str],
-                         baseline_headroom: int) -> dict:
+                         baseline_headroom: int,
+                         profile=None, snapshot=None) -> dict:
     """Run-identity fingerprint stored in the journal header.  Scenario
     names are hashed (a 10k-scenario random sweep should not bloat the
-    header) in order — resume requires the same enumeration."""
+    header) in order — resume requires the same enumeration.
+
+    `profile` (SchedulerProfile) and `snapshot` (ClusterSnapshot) pin the
+    full run configuration: a profile edit that only changes drain
+    re-scheduling, or a snapshot edit that happens to preserve the baseline
+    headroom, must NOT pass the resume check — mixing their rows into one
+    report would be silent corruption.  None omits the corresponding key
+    (journal tests that never resume a real sweep)."""
+    import dataclasses
+
     names_hash = hashlib.sha256(
         "\x00".join(scenario_names).encode()).hexdigest()
     probe_hash = hashlib.sha256(
         json.dumps(probe, sort_keys=True).encode()).hexdigest()
-    return {"probe": probe_hash, "numNodes": int(num_nodes),
-            "maxLimit": int(max_limit), "scenarios": names_hash,
-            "baselineHeadroom": int(baseline_headroom)}
+    fp = {"probe": probe_hash, "numNodes": int(num_nodes),
+          "maxLimit": int(max_limit), "scenarios": names_hash,
+          "baselineHeadroom": int(baseline_headroom)}
+    if profile is not None:
+        # default=str: exotic profile members (extenders with a default
+        # repr) may fingerprint unstably, which fails SAFE — resume refuses
+        # rather than accepting a journal it cannot vouch for
+        fp["profile"] = hashlib.sha256(json.dumps(
+            dataclasses.asdict(profile), sort_keys=True,
+            default=str).encode()).hexdigest()
+    if snapshot is not None:
+        fp["snapshot"] = snapshot_digest(snapshot)
+    return fp

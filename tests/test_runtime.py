@@ -154,6 +154,15 @@ def test_guard_propagates_engine_bugs_raw():
         guard.run(bug, site=faults.SITE_SOLVE)
 
 
+def test_error_kind_propagates_unclassified():
+    # the `error` kind simulates a device failure the classifier does not
+    # recognize — the ladder must NOT absorb it
+    pb = _pb()
+    with faults.inject("engine.solve:error"):
+        with pytest.raises(faults.SimulatedDeviceError, match="INTERNAL"):
+            degrade.solve_one_guarded(pb)
+
+
 def test_validate_result_rejects_bad_planes():
     ok = sim.SolveResult(placements=[0, 1], placed_count=2,
                          fail_type="", fail_message="",
@@ -444,6 +453,48 @@ def test_journal_tolerates_truncated_tail_only(tmp_path):
         checkpoint.ScenarioJournal(path).read()
 
 
+def test_journal_reopen_truncates_partial_tail(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    with checkpoint.ScenarioJournal(path) as j:
+        j.start(_fingerprint())
+        j.append("a", {"headroom": 3})
+        j.append("b", {"headroom": 0})
+    lines = open(path).readlines()
+    # crash artifact: final record half-written.  reopen() must truncate it
+    # before appending — gluing a new record onto the partial tail would
+    # produce a mid-file corrupt line that bricks every later read()
+    open(path, "w").write("".join(lines[:-1]) + lines[-1][:20])
+    j = checkpoint.ScenarioJournal(path)
+    _, done = j.read()
+    assert done == {"a": {"headroom": 3}}
+    j.reopen()
+    j.append("c", {"headroom": 1})
+    j.close()
+    _, done = checkpoint.ScenarioJournal(path).read()
+    assert done == {"a": {"headroom": 3}, "c": {"headroom": 1}}
+
+
+def test_fingerprint_pins_profile_and_snapshot():
+    snap = _snapshot()
+    kw = dict(probe=_probe(), num_nodes=3, max_limit=0,
+              scenario_names=["a"], baseline_headroom=7)
+    base = checkpoint.scenario_fingerprint(
+        **kw, profile=SchedulerProfile(), snapshot=snap)
+    # a profile edit that leaves the baseline probe headroom untouched
+    # (preemption messaging only affects drain re-scheduling output)
+    changed_profile = checkpoint.scenario_fingerprint(
+        **kw, profile=SchedulerProfile(include_preemption_message=True),
+        snapshot=snap)
+    assert changed_profile != base
+    # a same-sized snapshot edit
+    snap2 = ClusterSnapshot.from_objects(
+        [build_test_node(f"n{i}", 2000, 4 * 1024 ** 3, 8) for i in range(2)]
+        + [build_test_node("n2", 3000, 4 * 1024 ** 3, 8)])
+    changed_snap = checkpoint.scenario_fingerprint(
+        **kw, profile=SchedulerProfile(), snapshot=snap2)
+    assert changed_snap != base
+
+
 def test_journal_missing_header_rejected(tmp_path):
     path = str(tmp_path / "j.jsonl")
     with checkpoint.ScenarioJournal(path) as j:
@@ -492,11 +543,64 @@ def test_resume_rejects_foreign_fingerprint(tmp_path):
     snap = _sweep_snapshot()
     path = str(tmp_path / "sweep.jsonl")
     _analyze(snap, journal=path)
+    from cluster_capacity_tpu.resilience import (analyze,
+                                                 single_node_scenarios)
     with pytest.raises(CheckpointCorruption, match="different sweep"):
-        from cluster_capacity_tpu.resilience import (analyze,
-                                                     single_node_scenarios)
         analyze(snap, single_node_scenarios(snap), _probe(cpu=123),
                 profile=SchedulerProfile(), journal=path, resume=True)
+    # a profile edit changes no scenario name and no baseline headroom —
+    # only the fingerprint's profile hash can refuse it
+    with pytest.raises(CheckpointCorruption, match="different sweep"):
+        analyze(snap, single_node_scenarios(snap), _probe(),
+                profile=SchedulerProfile(include_preemption_message=True),
+                journal=path, resume=True)
+
+
+def _seq_sweep_snapshot():
+    # distinct capacities: no symmetric-dedup collapse; no resident pods:
+    # the drain phase runs no framework solves, so engine.solve call
+    # counting below stays exact
+    nodes = [build_test_node(f"n{i}", 1000 + 200 * i, 4 * 1024 ** 3, 8)
+             for i in range(5)]
+    return ClusterSnapshot.from_objects(nodes)
+
+
+def _seq_probe():
+    # a volume disqualifies the masked batched path (_mask_exact), forcing
+    # one sequential deleted-snapshot solve per scenario
+    probe = _probe()
+    probe["spec"]["volumes"] = [{"name": "scratch", "emptyDir": {}}]
+    return probe
+
+
+def test_interrupted_sweep_journals_finished_prefix(tmp_path):
+    """A sweep ACTUALLY killed mid-flight (not a post-hoc truncated
+    journal) must leave the scenarios completed before the interrupt on
+    disk, and --resume must finish to the uninterrupted report."""
+    from cluster_capacity_tpu.resilience import analyze, single_node_scenarios
+    snap = _seq_sweep_snapshot()
+    probe = _seq_probe()
+
+    def _run(**kw):
+        return analyze(snap, single_node_scenarios(snap), probe,
+                       profile=SchedulerProfile(), **kw)
+
+    full = _run()
+    assert all(not r.batched and r.deduped_of is None
+               for r in full.scenarios)
+
+    path = str(tmp_path / "sweep.jsonl")
+    # engine.solve call 1 is the baseline probe; calls 2.. are the five
+    # sequential scenarios — an unclassified error at call 4 kills the
+    # sweep with exactly two scenarios finished
+    with faults.inject("engine.solve:error:4"):
+        with pytest.raises(faults.SimulatedDeviceError):
+            _run(journal=path)
+    _, done = checkpoint.ScenarioJournal(path).read()
+    assert set(done) == {full.scenarios[0].name, full.scenarios[1].name}
+
+    resumed = _run(journal=path, resume=True)
+    assert resumed.to_dict() == full.to_dict()
 
 
 def test_degraded_sweep_bit_identical_and_flagged():
@@ -556,6 +660,21 @@ def test_cli_inject_fault_strict_and_envelope(tmp_path, capsys):
     rc = cc.run(["--snapshot", snap, "--podspec", pod,
                  "--inject-fault", "bogus-spec"])
     assert rc == 1
+
+
+def test_watch_strict_exits_on_first_degraded_run(tmp_path, capsys):
+    from cluster_capacity_tpu.cli import cluster_capacity as cc
+    snap, pod = _write_cluster(tmp_path)
+    # the fault fires on run 1 only; --strict must end the watch loop right
+    # there with status 3 — not keep looping until the (test-hook) run cap
+    rc = cc.run(["--snapshot", snap, "--podspec", pod, "--watch",
+                 "--period", "0.01", "--period-iterations", "3",
+                 "--strict", "-o", "json",
+                 "--inject-fault", "engine.solve:oom"])
+    out = capsys.readouterr().out
+    assert rc == 3
+    assert out.count('"degraded"') == 1   # exactly one report was printed
+    faults.clear()
 
 
 def test_resilience_cli_journal_resume_and_strict(tmp_path, capsys):
