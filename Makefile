@@ -26,6 +26,7 @@ $(NATIVE_LIB): native/ccsnap.cpp
 lint:
 	$(PY) tools/lint.py
 	$(PY) -m tools.jaxlint
+	$(PY) -m tools.irgate
 
 # Unit + behavioral suite (fake in-memory clusters; no hardware needed).
 test-unit:

@@ -161,9 +161,16 @@ class ClusterCapacity:
             if self.max_limit and remaining <= 0:
                 break
             if profile.extenders:
+                # extender solves go through the same supervisor as every
+                # other device dispatch (irgate GD001): there is no lower
+                # rung that can reproduce extender semantics, so faults
+                # surface as structured RuntimeFaults instead of degrading.
                 from .engine.extenders import solve_with_extenders
-                result = solve_with_extenders(problem, profile.extenders,
-                                              max_limit=remaining)
+                from .runtime import faults, guard
+                result = guard.run(
+                    solve_with_extenders, problem, profile.extenders,
+                    max_limit=remaining, site=faults.SITE_EXTENDERS,
+                    validate_nodes=problem.snapshot.num_nodes)
             else:
                 result = solve_one_guarded(problem, max_limit=remaining)
             cycle_results.append(result)

@@ -982,9 +982,23 @@ def sweep_interleaved_auto(snapshot: ClusterSnapshot,
                            templates: Sequence[dict],
                            profile: Optional[SchedulerProfile] = None,
                            max_total: int = 0) -> List[sim.SolveResult]:
-    """Tensor engine when eligible, object-level queue loop otherwise."""
-    res = solve_interleaved_tensor(snapshot, templates, profile,
-                                   max_total=max_total)
+    """Tensor engine when eligible, object-level queue loop otherwise.
+
+    The tensor dispatch runs under runtime/guard.run (irgate GD001); a
+    classified device fault degrades to the object-level parity loop —
+    the natural lower rung for the multi-template path — instead of
+    crashing the sweep.
+    """
+    from ..runtime import faults, guard
+    from ..runtime.errors import RuntimeFault
+
+    try:
+        res = guard.run(solve_interleaved_tensor, snapshot, templates,
+                        profile, max_total=max_total,
+                        site=faults.SITE_INTERLEAVE,
+                        validate_nodes=snapshot.num_nodes)
+    except RuntimeFault:
+        res = None              # degrade to the object-level queue loop
     if res is not None:
         return res
     from .sweep import sweep_interleaved

@@ -50,7 +50,10 @@ SITE_SOLVE = "engine.solve"
 SITE_FAST_PATH = "engine.fast_path"
 SITE_ORACLE = "engine.oracle"
 SITE_GROUP = "parallel.solve_group"
-SITES = (SITE_SOLVE, SITE_FAST_PATH, SITE_ORACLE, SITE_GROUP)
+SITE_EXTENDERS = "engine.extenders"
+SITE_INTERLEAVE = "parallel.interleave"
+SITES = (SITE_SOLVE, SITE_FAST_PATH, SITE_ORACLE, SITE_GROUP,
+         SITE_EXTENDERS, SITE_INTERLEAVE)
 
 
 class SimulatedHang(Exception):

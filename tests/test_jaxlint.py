@@ -577,3 +577,47 @@ def test_retrace_budget_extenders():
         res = solve_with_extenders(pb(150), [ext], max_limit=5)
     assert res.placed_count == 5
     assert log.compiles == [], log.compiles
+
+
+# ---------------------------------------------------------------------------
+# suppression reporting: tally + dead-suppression detection
+# ---------------------------------------------------------------------------
+
+def test_apply_suppressions_ex_partitions_and_tracks_dead():
+    from tools.jaxlint.common import apply_suppressions, apply_suppressions_ex
+    src = ('"""m."""\n'
+           'a = 1  # jaxlint: disable=DT001\n'
+           'b = 2  # jaxlint: disable=TS001\n')
+    hit = Finding("m.py", 2, "DT001", "msg")
+    kept_f = Finding("m.py", 4, "RC001", "msg")
+    rep = apply_suppressions_ex([hit, kept_f], src)
+    assert rep.kept == [kept_f]
+    assert rep.suppressed == [hit]
+    # line 3's TS001 comment ate nothing -> dead, flagged for pruning
+    assert rep.dead == [(3, "TS001")]
+    # legacy entry point stays finding-list-shaped (back-compat)
+    assert apply_suppressions([hit, kept_f], src) == [kept_f]
+
+
+def test_dead_suppression_surfaces_in_clean_file():
+    from tools.jaxlint import build_program, run_passes_ex
+    src = ('"""m."""  # jaxlint: disable-file=HS001\n'
+           'x = 1\n')
+    rep = run_passes_ex(build_program([("cluster_capacity_tpu/_mem.py",
+                                        src)]))
+    assert rep.findings == [] and rep.suppressed == []
+    assert rep.dead == [("cluster_capacity_tpu/_mem.py", 0, "HS001")]
+
+
+def test_suppressed_findings_reported_not_dropped():
+    from tools.jaxlint import build_program, run_passes_ex
+    src = ('"""m."""\n'
+           'import numpy as np\n'
+           '\n'
+           '\n'
+           'def f(n):\n'
+           '    return np.zeros(n, dtype=int)  # jaxlint: disable=DT001\n')
+    rep = run_passes_ex(build_program([("cluster_capacity_tpu/_mem.py",
+                                        src)]))
+    assert rep.findings == [] and rep.dead == []
+    assert [f.rule for f in rep.suppressed] == ["DT001"]

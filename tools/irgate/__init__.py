@@ -1,0 +1,37 @@
+"""irgate: jaxpr/StableHLO-level IR contracts, static cost budgets, and a
+guard-dispatch audit for the TPU engine.
+
+jaxlint (tools/jaxlint) polices the *source text* of the hot path and
+mosaic_lint polices Pallas BlockSpecs; irgate closes the remaining gap by
+inspecting what the engine actually *lowers to*.  It captures every jitted
+dispatch made by a canonical ladder of entry points (tools/irgate/
+entries.py), re-traces them to jaxprs and StableHLO on CPU, and enforces:
+
+1. IR contracts (contracts.py): no host callbacks, no f64 casts, no
+   data-dependent `while`, no dead donations, dtype-flow per rung.
+2. Static cost budgets (costs.py + budgets.py): primitive counts, FLOP
+   estimates and peak live-bytes pinned in budgets.json with percentage
+   tolerances and an `--update-budgets` flow.
+3. Guard-dispatch audit (guard_audit.py): an AST call-graph pass proving
+   every device dispatch in cluster_capacity_tpu/ routes through
+   runtime/guard.run.
+
+Run `python -m tools.irgate`; see doc/architecture.md ("IR gate") and
+examples/irgate.md.
+"""
+
+from .budgets import BudgetFinding, compare, deltas
+from .capture import Captured, capturing, dedup, install, uninstall
+from .contracts import IrFinding, Policy, check_captured
+from .costs import cost_summary, estimate_flops, peak_live_bytes, \
+    primitive_histogram
+from .entries import EntrySpec, canonical_entries, mosaic_findings, run_entry
+from .guard_audit import AuditFinding, audit_source, audit_tree
+
+__all__ = [
+    "AuditFinding", "BudgetFinding", "Captured", "EntrySpec", "IrFinding",
+    "Policy", "audit_source", "audit_tree", "canonical_entries", "capturing",
+    "check_captured", "compare", "cost_summary", "dedup", "deltas",
+    "estimate_flops", "install", "mosaic_findings", "peak_live_bytes",
+    "primitive_histogram", "run_entry", "uninstall",
+]

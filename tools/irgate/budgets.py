@@ -1,0 +1,145 @@
+"""Static cost budgets: load, compare, and update ``budgets.json``.
+
+The committed file pins, per entry, the three cost metrics
+(primitive count, estimated FLOPs, peak live-bytes) plus the full
+primitive histogram.  ``compare`` turns a fresh run against the pins into
+findings with *readable* deltas — the offending entry, the metric, the
+percentage move, and the primitives that moved most — so a CI failure
+reads like a diff, not a number.
+
+Tolerances are percentages and live in the file itself (so a deliberate
+loosening is itself a reviewed change).  ``--update-budgets`` rewrites the
+file from the current tree; the diff then shows exactly which entries and
+primitives moved.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+DEFAULT_PATH = os.path.join(os.path.dirname(__file__), "budgets.json")
+
+# default tolerance (percent) per metric; live_bytes/flops are estimates
+# over coarse models, so they get more slack than the exact primitive count
+DEFAULT_TOLERANCE = {"primitives": 5.0, "flops": 25.0, "live_bytes": 25.0}
+
+_HEADER = (
+    "Static IR cost budgets pinned by tools/irgate (PR 5).  Regenerate "
+    "with `python -m tools.irgate --update-budgets` and review the diff; "
+    "tolerances are percentages and are part of the reviewed contract.")
+
+
+@dataclass(frozen=True)
+class BudgetFinding:
+    """One budget violation (entry-level)."""
+
+    entry: str
+    rule: str
+    message: str
+
+    def render(self) -> str:
+        return f"irgate: {self.entry} {self.rule}: {self.message}"
+
+
+def load(path: str = DEFAULT_PATH) -> Optional[Dict[str, Any]]:
+    if not os.path.exists(path):
+        return None
+    with open(path, "r", encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def save(entries: Dict[str, Dict[str, Any]], path: str = DEFAULT_PATH,
+         tolerance: Optional[Dict[str, float]] = None) -> None:
+    doc = {
+        "_comment": _HEADER,
+        "tolerance_pct": dict(tolerance or DEFAULT_TOLERANCE),
+        "entries": {k: entries[k] for k in sorted(entries)},
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=False)
+        fh.write("\n")
+
+
+def _pct(new: float, old: float) -> float:
+    if old == 0:
+        return 0.0 if new == 0 else float("inf")
+    return (new - old) / old * 100.0
+
+
+def _histogram_delta(new: Dict[str, int], old: Dict[str, int],
+                     top: int = 3) -> str:
+    moved = []
+    for prim in sorted(set(new) | set(old)):
+        d = new.get(prim, 0) - old.get(prim, 0)
+        if d:
+            moved.append((abs(d), prim, d))
+    moved.sort(reverse=True)
+    parts = [f"{prim} {d:+d}" for _, prim, d in moved[:top]]
+    more = len(moved) - top
+    if more > 0:
+        parts.append(f"... {more} more")
+    return ", ".join(parts) if parts else "histogram unchanged"
+
+
+def compare(measured: Dict[str, Dict[str, Any]],
+            budgets: Optional[Dict[str, Any]]) -> List[BudgetFinding]:
+    """Measured entry summaries vs the committed pins → findings."""
+    findings: List[BudgetFinding] = []
+    if budgets is None:
+        findings.append(BudgetFinding(
+            "*", "BG000",
+            "no committed budgets.json — run `python -m tools.irgate "
+            "--update-budgets` and commit the file"))
+        return findings
+    tol = {**DEFAULT_TOLERANCE, **budgets.get("tolerance_pct", {})}
+    pinned: Dict[str, Any] = budgets.get("entries", {})
+    for name in sorted(measured):
+        if name not in pinned:
+            findings.append(BudgetFinding(
+                name, "BG001",
+                "entry has no committed budget — run --update-budgets "
+                "and review the new pin"))
+            continue
+        pin = pinned[name]
+        got = measured[name]
+        for metric in ("primitives", "flops", "live_bytes"):
+            old = pin.get(metric, 0)
+            new = got.get(metric, 0)
+            pct = _pct(new, old)
+            if abs(pct) > tol[metric]:
+                msg = (f"{metric} {old} -> {new} ({pct:+.1f}%, tolerance "
+                       f"±{tol[metric]:g}%)")
+                if metric == "primitives":
+                    msg += "; moved: " + _histogram_delta(
+                        got.get("histogram", {}), pin.get("histogram", {}))
+                findings.append(BudgetFinding(name, "BG002", msg))
+    for name in sorted(pinned):
+        if name not in measured:
+            findings.append(BudgetFinding(
+                name, "BG003",
+                "pinned entry was not produced by this run — stale budget "
+                "or a driver regression; run --update-budgets if the entry "
+                "was deliberately removed"))
+    return findings
+
+
+def deltas(measured: Dict[str, Dict[str, Any]],
+           budgets: Optional[Dict[str, Any]]) -> Dict[str, Dict[str, float]]:
+    """BENCH_*-style trend payload: per-entry percentage deltas vs pins
+    (0.0 everywhere on a healthy tree)."""
+    out: Dict[str, Dict[str, float]] = {}
+    pinned = (budgets or {}).get("entries", {})
+    for name, got in sorted(measured.items()):
+        pin = pinned.get(name)
+        if pin is None:
+            out[name] = {m: float("nan") for m in
+                         ("primitives", "flops", "live_bytes")}
+            continue
+        out[name] = {
+            m: round(_pct(got.get(m, 0), pin.get(m, 0)), 3)
+            for m in ("primitives", "flops", "live_bytes")
+        }
+    return out

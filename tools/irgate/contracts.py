@@ -1,0 +1,212 @@
+"""IR contracts: properties every lowered engine computation must satisfy.
+
+Rules (entry/computation context is attached by the caller):
+
+- IC001  host callback primitive in the lowered program
+         (``pure_callback`` / ``io_callback`` / ``debug_callback`` /
+         infeed/outfeed — a device kernel must never bounce to the host).
+- IC002  ``convert_element_type`` to float64: the engine's compute dtype is
+         profile-selected (float32 by default); an f64 cast in a float32
+         program is a silent 2× memory/bandwidth regression.
+- IC003  data-dependent ``while`` where a ``fori``/``scan`` is expected:
+         the engine's loops all have static trip counts, so any ``while``
+         primitive above the per-entry allowance is a smuggled dynamic
+         loop (unbounded device time, no pipelining).
+- IC004  donated-but-unused buffer: an input declared donated whose leaves
+         never feed an equation — the donation silently does nothing (or
+         worse, invalidates a buffer the caller still holds).
+- IC005  dtype-flow: no value anywhere in the program (inputs, outputs,
+         intermediates, sub-jaxpr bodies) may carry a dtype outside the
+         entry's allowed set — the jaxpr-level generalization of IC002,
+         catching f64 that arrives via transfer rather than a cast.
+
+StableHLO text checks back the jaxpr checks: IC001 also scans the lowered
+module for host-callback custom_call targets, and IC002/IC005 for ``f64``
+type annotations, so a primitive that hides its dtype at jaxpr level still
+trips at HLO level.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+from . import costs
+
+RULES: Dict[str, str] = {
+    "IC001": "host callback primitive in lowered program",
+    "IC002": "float64 convert_element_type",
+    "IC003": "data-dependent while loop (fori/scan expected)",
+    "IC004": "donated-but-unused buffer",
+    "IC005": "dtype outside the entry's allowed set",
+    "IC006": "entry expected zero device dispatches",
+}
+
+_CALLBACK_MARKERS = ("callback", "infeed", "outfeed", "outside_call")
+_HLO_CALLBACK_RE = re.compile(
+    r'custom_call[^\n]*call_target_name\s*=\s*"[^"]*callback[^"]*"')
+_HLO_F64_RE = re.compile(r"\btensor<(?:\d+x)*f64>|\bf64\b")
+
+
+@dataclass(frozen=True)
+class IrFinding:
+    """One contract violation, formatted like jaxlint's findings."""
+
+    entry: str
+    computation: str
+    rule: str
+    message: str
+
+    def render(self) -> str:
+        return (f"irgate: {self.entry} [{self.computation}] "
+                f"{self.rule}: {self.message}")
+
+
+@dataclass
+class Policy:
+    """Per-entry contract policy; defaults match the engine's float32
+    profile (the strictest rung)."""
+
+    forbid_f64: bool = True
+    max_while: int = 0
+    allowed_dtypes: Tuple[str, ...] = (
+        "float32", "int32", "int8", "uint8", "uint32", "bool")
+    check_dtype_flow: bool = True
+    check_stablehlo: bool = True
+
+
+def _is_callback(prim_name: str) -> bool:
+    return any(m in prim_name for m in _CALLBACK_MARKERS)
+
+
+def _all_avals(jaxpr):
+    """Yield every aval in a jaxpr, recursively."""
+    for v in list(jaxpr.invars) + list(jaxpr.constvars) + list(jaxpr.outvars):
+        if hasattr(v, "aval"):
+            yield v.aval
+    for eqn in jaxpr.eqns:
+        for v in list(eqn.invars) + list(eqn.outvars):
+            if hasattr(v, "aval"):
+                yield v.aval
+        for _, sub in costs._subjaxprs(eqn.params):
+            yield from _all_avals(sub)
+
+
+def _check_jaxpr(entry: str, comp: str, closed_jaxpr,
+                 policy: Policy) -> List[IrFinding]:
+    findings: List[IrFinding] = []
+    while_count = 0
+    for eqn in costs.iter_eqns(closed_jaxpr.jaxpr):
+        name = eqn.primitive.name
+        if _is_callback(name):
+            findings.append(IrFinding(
+                entry, comp, "IC001",
+                f"host callback primitive `{name}` in lowered program"))
+        if name == "while":
+            while_count += 1
+        if policy.forbid_f64 and name == "convert_element_type":
+            new = eqn.params.get("new_dtype")
+            if new is not None and "float64" in str(new):
+                findings.append(IrFinding(
+                    entry, comp, "IC002",
+                    "convert_element_type to float64 (engine compute dtype "
+                    "is float32 for this entry)"))
+    if while_count > policy.max_while:
+        findings.append(IrFinding(
+            entry, comp, "IC003",
+            f"{while_count} data-dependent `while` loop(s); entry allows "
+            f"{policy.max_while} (use fori/scan with a static trip count)"))
+    if policy.check_dtype_flow:
+        bad: Set[str] = set()
+        for aval in _all_avals(closed_jaxpr.jaxpr):
+            dt = str(getattr(aval, "dtype", ""))
+            if not dt:
+                continue
+            if dt not in policy.allowed_dtypes and \
+                    not dt.startswith("key<"):
+                if policy.forbid_f64 or "64" not in dt:
+                    bad.add(dt)
+        if bad:
+            findings.append(IrFinding(
+                entry, comp, "IC005",
+                f"dtype(s) {sorted(bad)} flow through the program; allowed: "
+                f"{list(policy.allowed_dtypes)}"))
+    return findings
+
+
+def _check_donation(entry: str, comp: str, captured) -> List[IrFinding]:
+    """IC004 via Lowered.args_info: flattened donated flags line up with the
+    jaxpr's invars; a donated invar with zero uses is a dead donation."""
+    try:
+        lowered = captured.lowered()
+        info_leaves = _flatten_args_info(lowered.args_info)
+    except Exception:
+        return []                # older jax: skip rather than false-positive
+    if not any(getattr(i, "donated", False) for i in info_leaves):
+        return []
+    jaxpr = captured.closed_jaxpr.jaxpr
+    if len(info_leaves) != len(jaxpr.invars):
+        return []                # cannot align: don't guess
+    used = set()
+    for eqn in costs.iter_eqns(jaxpr):
+        for v in eqn.invars:
+            used.add(id(v))
+    for v in jaxpr.outvars:
+        used.add(id(v))
+    findings = []
+    for pos, (info, var) in enumerate(zip(info_leaves, jaxpr.invars)):
+        if getattr(info, "donated", False) and id(var) not in used:
+            findings.append(IrFinding(
+                entry, comp, "IC004",
+                f"argument #{pos} is donated but never read by the "
+                f"program — dead donation"))
+    return findings
+
+
+def _flatten_args_info(args_info):
+    import jax
+
+    return jax.tree_util.tree_leaves(args_info)
+
+
+def _check_stablehlo(entry: str, comp: str, hlo_text: str,
+                     policy: Policy) -> List[IrFinding]:
+    findings = []
+    if _HLO_CALLBACK_RE.search(hlo_text):
+        findings.append(IrFinding(
+            entry, comp, "IC001",
+            "StableHLO module contains a host-callback custom_call"))
+    if policy.forbid_f64 and _HLO_F64_RE.search(hlo_text):
+        findings.append(IrFinding(
+            entry, comp, "IC002",
+            "StableHLO module contains f64-typed values"))
+    return findings
+
+
+def check_captured(entry: str, captured, policy: Optional[Policy] = None,
+                   ) -> List[IrFinding]:
+    """Run every contract over one captured computation."""
+    policy = policy or Policy()
+    comp = captured.key
+    findings = _check_jaxpr(entry, comp, captured.closed_jaxpr, policy)
+    findings += _check_donation(entry, comp, captured)
+    if policy.check_stablehlo:
+        try:
+            hlo = captured.stablehlo
+        except Exception:
+            hlo = None           # some interpret-mode programs can't lower
+        if hlo is not None:
+            findings += _check_stablehlo(entry, comp, hlo, policy)
+    return _dedup(findings)
+
+
+def _dedup(findings: Sequence[IrFinding]) -> List[IrFinding]:
+    seen = set()
+    out = []
+    for f in findings:
+        k = (f.entry, f.computation, f.rule, f.message)
+        if k not in seen:
+            seen.add(k)
+            out.append(f)
+    return out

@@ -1,0 +1,180 @@
+"""Static cost models computed from a jaxpr: primitive counts, estimated
+FLOPs, and peak live-bytes.
+
+These are *budget* metrics, not performance predictions: the point is that
+each number is deterministic for a fixed program, so a PR that inflates the
+IR (an extra broadcast chain, a widened dtype, an unrolled loop) moves the
+number and trips the committed tolerance in ``budgets.json``.
+
+- ``primitive_histogram`` walks the jaxpr recursively (scan/cond/while
+  bodies are descended into once each — a scan body is one trace however
+  many steps it runs).
+- ``estimate_flops`` uses a coarse roofline-style model: ``dot_general`` is
+  2·M·N·K, elementwise ops cost one flop per output element, reductions one
+  per input element, and a ``scan``'s body cost is multiplied by its static
+  ``length`` parameter.  Shape-only ops (broadcast, reshape, transpose,
+  convert, slice, gather/scatter addressing) count zero.
+- ``peak_live_bytes`` runs a linear liveness scan over the top-level
+  equations: a value is live from the equation that defines it until its
+  last use; the peak is the maximum of the running total plus invars.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Any, Dict, Iterable, Iterator, Tuple
+
+# primitives whose output is pure data movement / metadata: zero flops
+_ZERO_FLOP = {
+    "broadcast_in_dim", "reshape", "transpose", "convert_element_type",
+    "slice", "dynamic_slice", "dynamic_update_slice", "squeeze",
+    "concatenate", "pad", "rev", "iota", "copy", "stop_gradient",
+    "gather", "scatter", "bitcast_convert_type", "device_put",
+    "split", "expand_dims",
+}
+
+# reductions: one flop per *input* element
+_REDUCE = {
+    "reduce_sum", "reduce_max", "reduce_min", "reduce_prod", "reduce_and",
+    "reduce_or", "argmax", "argmin", "cumsum", "cummax", "cummin",
+    "cumprod", "cumlogsumexp", "sort",
+}
+
+
+def _subjaxprs(params: Dict[str, Any]) -> Iterator[Tuple[str, Any]]:
+    """Yield (param_name, jaxpr) for every jaxpr-valued equation param."""
+    for name, value in params.items():
+        for item in (value if isinstance(value, (list, tuple)) else [value]):
+            jx = getattr(item, "jaxpr", None)
+            if jx is not None and hasattr(jx, "eqns"):
+                yield name, jx
+            elif hasattr(item, "eqns"):
+                yield name, item
+
+
+def iter_eqns(jaxpr) -> Iterator[Any]:
+    """All equations in a jaxpr, descending into sub-jaxprs (bodies counted
+    once, independent of trip count)."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for _, sub in _subjaxprs(eqn.params):
+            yield from iter_eqns(sub)
+
+
+def primitive_histogram(closed_jaxpr) -> Counter:
+    """Counter of primitive name → static occurrence count."""
+    hist: Counter = Counter()
+    for eqn in iter_eqns(closed_jaxpr.jaxpr):
+        hist[eqn.primitive.name] += 1
+    return hist
+
+
+def _aval_size(aval) -> int:
+    shape = getattr(aval, "shape", ())
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n
+
+
+def aval_bytes(aval) -> int:
+    dtype = getattr(aval, "dtype", None)
+    itemsize = getattr(dtype, "itemsize", 1) if dtype is not None else 1
+    return _aval_size(aval) * int(itemsize)
+
+
+def _eqn_flops(eqn) -> float:
+    name = eqn.primitive.name
+    if name in _ZERO_FLOP:
+        return 0.0
+    if name == "dot_general":
+        dims = eqn.params.get("dimension_numbers")
+        (lc, rc), (lb, rb) = dims
+        lhs = eqn.invars[0].aval
+        out = eqn.outvars[0].aval
+        k = 1
+        for ax in lc:
+            k *= int(lhs.shape[ax])
+        return 2.0 * _aval_size(out) * k
+    if name in _REDUCE:
+        return float(sum(_aval_size(v.aval) for v in eqn.invars
+                         if hasattr(v, "aval")))
+    if name in ("while", "scan", "cond", "pjit", "closed_call",
+                "custom_jvp_call", "custom_vjp_call", "remat", "checkpoint",
+                "pallas_call"):
+        return 0.0  # body cost handled by the recursive walk
+    # elementwise default: one flop per output element
+    return float(sum(_aval_size(v.aval) for v in eqn.outvars
+                     if hasattr(v, "aval")))
+
+
+def _jaxpr_flops(jaxpr) -> float:
+    total = 0.0
+    for eqn in jaxpr.eqns:
+        total += _eqn_flops(eqn)
+        mult = 1.0
+        if eqn.primitive.name == "scan":
+            mult = float(eqn.params.get("length", 1) or 1)
+        for _, sub in _subjaxprs(eqn.params):
+            total += mult * _jaxpr_flops(sub)
+    return total
+
+
+def estimate_flops(closed_jaxpr) -> int:
+    """Coarse static FLOP estimate (scan bodies × static trip count)."""
+    return int(_jaxpr_flops(closed_jaxpr.jaxpr))
+
+
+def peak_live_bytes(closed_jaxpr) -> int:
+    """Peak bytes simultaneously live across the top-level equation list."""
+    jaxpr = closed_jaxpr.jaxpr
+    last_use: Dict[Any, int] = {}
+    for i, eqn in enumerate(jaxpr.eqns):
+        for v in eqn.invars:
+            if hasattr(v, "count"):       # Var, not Literal
+                last_use[v] = i
+    n_eqns = len(jaxpr.eqns)
+    for v in jaxpr.outvars:
+        if hasattr(v, "count"):
+            last_use[v] = n_eqns          # outputs live to the end
+    live = 0
+    for v in list(jaxpr.invars) + list(jaxpr.constvars):
+        live += aval_bytes(v.aval)
+    peak = live
+    for i, eqn in enumerate(jaxpr.eqns):
+        for v in eqn.outvars:
+            if v not in last_use:
+                last_use[v] = i           # dead value: dies immediately
+    for i, eqn in enumerate(jaxpr.eqns):
+        for v in eqn.outvars:
+            live += aval_bytes(v.aval)
+        peak = max(peak, live)
+        for v, last in list(last_use.items()):
+            if last == i:
+                live -= aval_bytes(v.aval)
+                del last_use[v]
+    return int(peak)
+
+
+def cost_summary(closed_jaxpr) -> Dict[str, Any]:
+    """The three budget metrics plus the full histogram, JSON-ready."""
+    hist = primitive_histogram(closed_jaxpr)
+    return {
+        "primitives": int(sum(hist.values())),
+        "flops": estimate_flops(closed_jaxpr),
+        "live_bytes": peak_live_bytes(closed_jaxpr),
+        "histogram": {k: int(v) for k, v in sorted(hist.items())},
+    }
+
+
+def merge_summaries(summaries: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
+    """Entry-level rollup: sum metrics and histograms across computations."""
+    out = {"primitives": 0, "flops": 0, "live_bytes": 0, "histogram": {}}
+    hist: Counter = Counter()
+    for s in summaries:
+        out["primitives"] += s["primitives"]
+        out["flops"] += s["flops"]
+        out["live_bytes"] = max(out["live_bytes"], s["live_bytes"])
+        hist.update(s["histogram"])
+    out["histogram"] = {k: int(v) for k, v in sorted(hist.items())}
+    return out

@@ -1,0 +1,262 @@
+"""Canonical entry-point ladder: the fixtures irgate lowers and audits.
+
+Each EntrySpec names one engine entry point at one canonical abstract shape
+and owns a driver that exercises it under jit-capture.  The ladder mirrors
+the PR-4 degradation ladder (fused_batched → fused → fast_path → oracle)
+plus the scan engine, the batched group solve, the extender kernels and the
+preemption loop, so `python -m tools.irgate` covers every rung a production
+solve can land on.
+
+Fixtures are tiny (3–8 nodes) and CPU-only: the Pallas rungs run in
+interpret mode via ``CC_TPU_FUSED=1`` (the env knob fused.eligible() reads
+at call time), and every entry uses the default float32 SchedulerProfile so
+any f64 anywhere in the lowered IR is a contract violation, not noise.
+
+The oracle rung is pinned the other way around: its driver runs the
+host-side reference and the gate asserts it captured ZERO device
+computations — the oracle escaping to the device would defeat its purpose
+as the rung of last resort.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from . import capture as cap
+from .contracts import Policy
+
+
+def _node(name: str, milli_cpu: int, mem: int, pods: int,
+          labels: Optional[dict] = None) -> dict:
+    alloc = {"cpu": f"{milli_cpu}m", "memory": str(mem), "pods": str(pods)}
+    return {
+        "metadata": {"name": name, "labels": dict(labels or {})},
+        "spec": {},
+        "status": {"allocatable": alloc, "capacity": dict(alloc)},
+    }
+
+
+def _pod(name: str, milli_cpu: int, mem: int, node_name: str = "",
+         labels: Optional[dict] = None) -> dict:
+    return {
+        "metadata": {"name": name, "namespace": "default",
+                     "labels": dict(labels or {})},
+        "spec": {
+            "containers": [{"name": "c0", "image": "img",
+                            "resources": {"requests": {
+                                "cpu": f"{milli_cpu}m",
+                                "memory": str(mem)}}}],
+            "nodeName": node_name,
+        },
+    }
+
+
+def _preferred_affinity(pod: dict, key: str, value: str) -> dict:
+    """Non-uniform preferred node affinity: keeps the problem off the
+    analytic fast path so the scan engine actually dispatches."""
+    pod["spec"]["affinity"] = {"nodeAffinity": {
+        "preferredDuringSchedulingIgnoredDuringExecution": [{
+            "weight": 1,
+            "preference": {"matchExpressions": [
+                {"key": key, "operator": "In", "values": [value]}]},
+        }]}}
+    return pod
+
+
+def _nodes(n: int) -> List[dict]:
+    out = []
+    for i in range(n):
+        labels = {"zone": f"z{i % 2}"}
+        if i == 0:
+            labels["tier"] = "gold"
+        out.append(_node(f"node-{i}", 2000 + 100 * i, int(1e9), 16,
+                         labels=labels))
+    return out
+
+
+def _problem(n: int, milli_cpu: int = 300, affinity: bool = False):
+    """EncodedProblem on the canonical n-node snapshot, float32 profile."""
+    from cluster_capacity_tpu.engine import encode as enc
+    from cluster_capacity_tpu.models.podspec import default_pod
+    from cluster_capacity_tpu.models.snapshot import ClusterSnapshot
+    from cluster_capacity_tpu.utils.config import SchedulerProfile
+
+    snapshot = ClusterSnapshot.from_objects(_nodes(n), [])
+    pod = _pod("probe", milli_cpu, int(5e7))
+    if affinity:
+        _preferred_affinity(pod, "tier", "gold")
+    return enc.encode_problem(snapshot, default_pod(pod), SchedulerProfile())
+
+
+@dataclass
+class EntrySpec:
+    """One audited entry point: a driver plus its contract policy."""
+
+    name: str
+    rung: str                       # degradation-ladder rung or "aux"
+    driver: Callable[[], None]
+    env: Dict[str, str] = field(default_factory=dict)
+    policy: Policy = field(default_factory=Policy)
+    expect_no_dispatch: bool = False
+
+
+@dataclass
+class EntryCapture:
+    """Result of running one entry under jit-capture."""
+
+    spec: EntrySpec
+    computations: List[cap.Captured]
+
+
+def _with_env(env: Dict[str, str], fn: Callable[[], None]) -> None:
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        fn()
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def run_entry(spec: EntrySpec) -> EntryCapture:
+    """Execute one driver with capture active; returns deduped records."""
+    with cap.capturing() as records:
+        _with_env(spec.env, spec.driver)
+    return EntryCapture(spec=spec, computations=cap.dedup(records))
+
+
+# ---------------------------------------------------------------------------
+# drivers
+# ---------------------------------------------------------------------------
+
+def _drive_scan(n: int):
+    def driver():
+        from cluster_capacity_tpu.engine import simulator as sim
+        sim.solve(_problem(n, affinity=True))
+    return driver
+
+
+def _drive_fused():
+    def driver():
+        from cluster_capacity_tpu.engine import simulator as sim
+        sim.solve(_problem(8))
+    return driver
+
+
+def _drive_group(b: int):
+    def driver():
+        from cluster_capacity_tpu.parallel import sweep as sweep_mod
+        pbs = [_problem(8) for _ in range(b)]
+        sweep_mod.solve_group(pbs)
+    return driver
+
+
+def _drive_fast_path(b: int):
+    def driver():
+        from cluster_capacity_tpu.engine import fast_path
+        pbs = [_problem(8) for _ in range(b)]
+        # the batched analytic kernel only engages at a positive limit
+        # (unlimited runs need the scan's exact diagnosis)
+        fast_path.solve_fast_batched(pbs, 4)
+    return driver
+
+
+def _drive_extenders():
+    def driver():
+        import jax.numpy as jnp
+        from cluster_capacity_tpu.engine import extenders
+        from cluster_capacity_tpu.engine import simulator as sim
+        pb = _problem(8)
+        cfg = sim.static_config(pb)
+        consts = sim.build_consts(pb)
+        carry = sim._init_carry(pb, consts, pb.profile.seed)
+        compute, apply = extenders._extender_kernels()
+        compute(cfg, consts, carry)
+        apply(cfg, consts, carry, jnp.asarray(0, jnp.int32))
+    return driver
+
+
+def _drive_preemption():
+    def driver():
+        from cluster_capacity_tpu import ClusterCapacity
+        from cluster_capacity_tpu.models.podspec import default_pod
+        from cluster_capacity_tpu.utils.config import SchedulerProfile
+        nodes = [_node("n1", 1000, int(1e9), 10, labels={"tier": "gold"}),
+                 _node("n2", 1000, int(1e9), 10)]
+        squatter = _pod("squatter", 800, int(1e6), node_name="n1")
+        squatter["spec"]["priority"] = -1
+        incoming = _preferred_affinity(
+            _pod("vip", 600, int(1e6)), "tier", "gold")
+        incoming["spec"]["priority"] = 100
+        cc = ClusterCapacity(default_pod(incoming), max_limit=0,
+                             profile=SchedulerProfile())
+        cc.sync_with_objects(nodes, [squatter])
+        cc.run()
+    return driver
+
+
+def _drive_oracle():
+    def driver():
+        from cluster_capacity_tpu.runtime import degrade
+        degrade._solve_oracle(_problem(4))
+    return driver
+
+
+def canonical_entries() -> List[EntrySpec]:
+    """The committed ladder; budget keys are derived from these names."""
+    fused_on = {"CC_TPU_FUSED": "1"}
+    fused_off = {"CC_TPU_FUSED": "0"}
+    return [
+        EntrySpec("fused_batched/n8b3", "fused_batched",
+                  _drive_group(3), env=fused_on),
+        EntrySpec("fused/n8", "fused", _drive_fused(), env=fused_on),
+        EntrySpec("solve_group/n8b3", "fused_batched",
+                  _drive_group(3), env=fused_off),
+        EntrySpec("scan/n8", "fused", _drive_scan(8), env=fused_off),
+        EntrySpec("scan/n16", "fused", _drive_scan(16), env=fused_off),
+        EntrySpec("fast_path/n8b3", "fast_path",
+                  _drive_fast_path(3), env=fused_off),
+        EntrySpec("extenders/n8", "aux", _drive_extenders(), env=fused_off),
+        EntrySpec("preemption/n2", "aux", _drive_preemption(),
+                  env=fused_off),
+        EntrySpec("oracle/n4", "oracle", _drive_oracle(), env=fused_off,
+                  expect_no_dispatch=True),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# mosaic fold-in (satellite): BlockSpec tables for the Pallas rungs
+# ---------------------------------------------------------------------------
+
+def mosaic_findings() -> List[str]:
+    """Run engine/mosaic_lint over the BlockSpec tables of both Pallas
+    kernels at the canonical shapes; returns violation strings (empty =
+    clean).  This folds the standalone mosaic_lint API into the irgate CLI
+    without moving it."""
+    from cluster_capacity_tpu.engine import fused
+    from cluster_capacity_tpu.engine import fused_batched as fb
+    from cluster_capacity_tpu.engine import mosaic_lint
+    from cluster_capacity_tpu.engine import simulator as sim
+
+    out: List[str] = []
+    pb = _problem(8)
+    k_steps = pb.max_steps_hint + 1
+    pk = fused._pack_meta(sim.static_config(pb), pb, None)
+    s_ins, s_outs = fused._spec_table(pk, k_steps)
+    for entry in list(s_ins) + list(s_outs):
+        for v in mosaic_lint.check_entry(entry):
+            out.append(f"fused kernel: {v}")
+
+    pbs = [_problem(8) for _ in range(3)]
+    pks = tuple(fused._pack_meta(sim.static_config(p), p, None) for p in pbs)
+    tab = fb._scalar_table(pks[0])
+    ins, outs = fb._batched_spec_table(pks[0], tab, len(pbs), k_steps)
+    for entry, _index_map in list(ins) + list(outs):
+        for v in mosaic_lint.check_entry(entry):
+            out.append(f"fused_batched kernel: {v}")
+    return out

@@ -1,0 +1,223 @@
+"""Guard-dispatch audit: prove every device dispatch routes through
+``runtime/guard.run``.
+
+PR 4 made ``guard.run`` the single choke point for device calls — faults,
+deadlines and output validation all live there.  That property only holds
+if no call site quietly invokes an engine solve directly, so this pass
+walks every module under ``cluster_capacity_tpu/`` as an AST, resolves
+import aliases, and flags calls to the *dispatch set* (the functions that
+launch device computations) unless the call is sanctioned:
+
+- the calling function is itself a member of the dispatch set (internal
+  composition: ``solve_auto`` calling ``solve_fast``, ``solve_group``
+  calling ``_batched_solve``);
+- the calling module lives under ``runtime/`` (the supervisor itself);
+- the call appears lexically inside an argument to ``guard.run(...)``
+  (the ``guard.run(lambda: sim.solve(...), site=...)`` idiom);
+Module-level exemption covers only ``runtime/`` itself; a dispatch
+module's *other* functions (e.g. a convenience router next to the real
+entry) get no blanket pass — they must either be dispatch-set members or
+wrap the call in ``guard.run`` like any other caller.
+
+Findings are GD001: "device dispatch outside guard.run".  ``audit_file``
+takes any path, so tests can aim the same pass at fixture modules.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+# (module suffix, function) pairs that launch device computations.
+DISPATCH_SET: Set[Tuple[str, str]] = {
+    ("engine.simulator", "solve"),
+    ("engine.fast_path", "solve_auto"),
+    ("engine.fast_path", "solve_fast"),
+    ("engine.fast_path", "solve_fast_batched"),
+    ("engine.extenders", "solve_with_extenders"),
+    ("parallel.sweep", "solve_group"),
+    ("parallel.sweep", "_batched_solve"),
+    ("parallel.distributed", "solve_on_mesh"),
+    ("parallel.interleave", "solve_interleaved_tensor"),
+}
+
+DISPATCH_MODULES = {m for m, _ in DISPATCH_SET}
+DISPATCH_NAMES = {f for _, f in DISPATCH_SET}
+
+_PKG = "cluster_capacity_tpu"
+
+
+@dataclass(frozen=True)
+class AuditFinding:
+    """One unguarded dispatch call site."""
+
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def render(self) -> str:
+        return f"irgate: {self.path}:{self.line}: {self.rule}: {self.message}"
+
+
+def _module_name(path: str, root: str) -> str:
+    rel = os.path.relpath(path, root)
+    mod = rel[:-3].replace(os.sep, ".")
+    if mod.endswith(".__init__"):
+        mod = mod[:-len(".__init__")]
+    return mod
+
+
+class _ImportMap(ast.NodeVisitor):
+    """local name → dotted module (or module.attr) it refers to."""
+
+    def __init__(self, module: str):
+        self.module = module
+        self.names: Dict[str, str] = {}
+
+    def _absolutize(self, node: ast.ImportFrom) -> str:
+        if node.level == 0:
+            return node.module or ""
+        parts = self.module.split(".")
+        base = parts[:-node.level] if node.level <= len(parts) else []
+        if node.module:
+            base = base + [node.module]
+        return ".".join(base)
+
+    def visit_Import(self, node: ast.Import):
+        for alias in node.names:
+            self.names[alias.asname or alias.name.split(".")[0]] = alias.name
+
+    def visit_ImportFrom(self, node: ast.ImportFrom):
+        base = self._absolutize(node)
+        for alias in node.names:
+            self.names[alias.asname or alias.name] = \
+                f"{base}.{alias.name}" if base else alias.name
+
+
+def _dispatch_target(map_: Dict[str, str], call: ast.Call,
+                     module: str = "") -> Optional[Tuple[str, str]]:
+    """Resolve a call node to a (module_suffix, func) in DISPATCH_SET."""
+    func = call.func
+    if isinstance(func, ast.Name) and func.id in DISPATCH_NAMES and \
+            func.id not in map_:
+        # bare name defined in this very module (same-module router)
+        for msuf, fname in DISPATCH_SET:
+            if fname == func.id and module.endswith(msuf):
+                return (msuf, fname)
+    if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
+        mod = map_.get(func.value.id)
+        if mod is None:
+            return None
+        for msuf, fname in DISPATCH_SET:
+            if fname == func.attr and \
+                    (mod.endswith(msuf) or mod.endswith(msuf.split(".")[-1])):
+                return (msuf, fname)
+        return None
+    if isinstance(func, ast.Name):
+        dotted = map_.get(func.id)
+        if dotted is None:
+            return None
+        for msuf, fname in DISPATCH_SET:
+            if dotted.endswith(f"{msuf}.{fname}"):
+                return (msuf, fname)
+        return None
+    return None
+
+
+def _is_guard_run(map_: Dict[str, str], call: ast.Call) -> bool:
+    func = call.func
+    if isinstance(func, ast.Attribute) and func.attr == "run" and \
+            isinstance(func.value, ast.Name):
+        mod = map_.get(func.value.id, "")
+        return mod.endswith("runtime.guard") or mod.endswith("guard")
+    if isinstance(func, ast.Name):
+        return map_.get(func.id, "").endswith("guard.run")
+    return False
+
+
+class _Auditor(ast.NodeVisitor):
+    def __init__(self, path: str, module: str, map_: Dict[str, str]):
+        self.path = path
+        self.module = module
+        self.map = map_
+        self.findings: List[AuditFinding] = []
+        self._func_stack: List[str] = []
+        self._guard_depth = 0
+
+    def _in_dispatch_fn(self) -> bool:
+        return any(name in DISPATCH_NAMES for name in self._func_stack)
+
+    def visit_FunctionDef(self, node):
+        self._func_stack.append(node.name)
+        self.generic_visit(node)
+        self._func_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call):
+        if _is_guard_run(self.map, node):
+            # everything lexically inside guard.run's argument list is
+            # sanctioned (including lambdas built in place)
+            self._guard_depth += 1
+            self.generic_visit(node)
+            self._guard_depth -= 1
+            return
+        target = _dispatch_target(self.map, node, self.module)
+        if target is not None and self._guard_depth == 0 \
+                and not self._in_dispatch_fn():
+            msuf, fname = target
+            self.findings.append(AuditFinding(
+                self.path, node.lineno, "GD001",
+                f"device dispatch `{msuf}.{fname}` called outside "
+                f"guard.run — route it through the runtime supervisor"))
+        self.generic_visit(node)
+
+
+def _exempt_module(module: str) -> bool:
+    suffix = module.split(f"{_PKG}.", 1)[-1]
+    return suffix.startswith("runtime.") or suffix == "runtime"
+
+
+def audit_source(source: str, path: str, module: str,
+                 exempt: Optional[bool] = None) -> List[AuditFinding]:
+    """Audit one module's source; `exempt` overrides module-level policy
+    (tests pass exempt=False to audit fixture files strictly)."""
+    tree = ast.parse(source)
+    if exempt is None:
+        exempt = _exempt_module(module)
+    if exempt:
+        return []
+    imap = _ImportMap(module)
+    imap.visit(tree)
+    auditor = _Auditor(path, module, imap.names)
+    auditor.visit(tree)
+    return auditor.findings
+
+
+def audit_file(path: str, root: str,
+               exempt: Optional[bool] = None) -> List[AuditFinding]:
+    with open(path, "r", encoding="utf-8") as fh:
+        source = fh.read()
+    module = _module_name(path, root)
+    return audit_source(source, os.path.relpath(path, root), module,
+                        exempt=exempt)
+
+
+def audit_tree(repo_root: str) -> Tuple[List[AuditFinding], int]:
+    """Audit every module under cluster_capacity_tpu/.  Returns (findings,
+    files_scanned)."""
+    findings: List[AuditFinding] = []
+    scanned = 0
+    pkg_root = os.path.join(repo_root, _PKG)
+    for dirpath, dirnames, filenames in os.walk(pkg_root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            scanned += 1
+            findings.extend(audit_file(path, repo_root))
+    return findings, scanned

@@ -11,13 +11,28 @@ from __future__ import annotations
 import os
 from typing import List, Optional, Sequence
 
+from typing import NamedTuple
+
 from . import (baseline, dtype_discipline, host_sync, recompile,
                trace_safety)
-from .common import Finding, PASSES, RULES, apply_suppressions
+from .common import Finding, PASSES, RULES, apply_suppressions, \
+    apply_suppressions_ex
 from .context import ModuleInfo, Program
 
-__all__ = ["Finding", "RULES", "PASSES", "lint_source", "lint_files",
-           "build_program", "run_passes", "baseline"]
+__all__ = ["Finding", "LintReport", "RULES", "PASSES", "lint_source",
+           "lint_files", "lint_files_ex", "build_program", "run_passes",
+           "run_passes_ex", "baseline"]
+
+
+class LintReport(NamedTuple):
+    """run_passes_ex result: surviving findings, what inline suppressions
+    ate (so the CLI can print a per-rule tally instead of silently dropping
+    them), and dead suppressions as (path, line, rule) with line 0 for
+    disable-file scope."""
+
+    findings: list
+    suppressed: list
+    dead: list
 
 _PASS_RUNNERS = (
     ("trace-safety", trace_safety.run),
@@ -37,24 +52,40 @@ def build_program(sources: Sequence[tuple]) -> Program:
     return Program(mods)
 
 
-def run_passes(prog: Program,
-               only: Optional[Sequence[str]] = None) -> List[Finding]:
+def run_passes_ex(prog: Program,
+                  only: Optional[Sequence[str]] = None) -> LintReport:
     findings: List[Finding] = []
     for name, runner in _PASS_RUNNERS:
         if only and name not in only:
             continue
         findings.extend(runner(prog))
-    by_path = {m.path: m.source for m in prog.modules}
-    findings = _suppress(findings, by_path)
-    return sorted(set(findings), key=lambda f: (f.path, f.line, f.rule))
+    kept, suppressed, dead = _suppress(findings, prog)
+    order = lambda f: (f.path, f.line, f.rule)
+    return LintReport(findings=sorted(set(kept), key=order),
+                      suppressed=sorted(set(suppressed), key=order),
+                      dead=sorted(dead))
 
 
-def _suppress(findings: List[Finding], by_path) -> List[Finding]:
-    out: List[Finding] = []
-    for path in sorted({f.path for f in findings}):
-        batch = [f for f in findings if f.path == path]
-        out.extend(apply_suppressions(batch, by_path[path]))
-    return out
+def run_passes(prog: Program,
+               only: Optional[Sequence[str]] = None) -> List[Finding]:
+    return run_passes_ex(prog, only=only).findings
+
+
+def _suppress(findings: List[Finding], prog: Program):
+    """Every module is scanned — not just modules with findings — so a
+    suppression comment in a clean file still shows up as dead."""
+    kept: List[Finding] = []
+    suppressed: List[Finding] = []
+    dead: List[tuple] = []
+    by_path = {f.path: [] for f in findings}
+    for f in findings:
+        by_path[f.path].append(f)
+    for m in prog.modules:
+        rep = apply_suppressions_ex(by_path.get(m.path, []), m.source)
+        kept.extend(rep.kept)
+        suppressed.extend(rep.suppressed)
+        dead.extend((m.path, line, rule) for line, rule in rep.dead)
+    return kept, suppressed, dead
 
 
 def lint_source(source: str, path: str = "cluster_capacity_tpu/_mem.py",
@@ -65,10 +96,15 @@ def lint_source(source: str, path: str = "cluster_capacity_tpu/_mem.py",
     return run_passes(build_program([(path, source)]), only=only)
 
 
-def lint_files(repo_root: str, relpaths: Sequence[str],
-               only: Optional[Sequence[str]] = None) -> List[Finding]:
+def lint_files_ex(repo_root: str, relpaths: Sequence[str],
+                  only: Optional[Sequence[str]] = None) -> LintReport:
     sources = []
     for rp in relpaths:
         with open(os.path.join(repo_root, rp)) as f:
             sources.append((rp.replace(os.sep, "/"), f.read()))
-    return run_passes(build_program(sources), only=only)
+    return run_passes_ex(build_program(sources), only=only)
+
+
+def lint_files(repo_root: str, relpaths: Sequence[str],
+               only: Optional[Sequence[str]] = None) -> List[Finding]:
+    return lint_files_ex(repo_root, relpaths, only=only).findings

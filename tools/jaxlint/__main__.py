@@ -25,7 +25,7 @@ if __package__ in (None, ""):           # `python tools/jaxlint/__main__.py`
     sys.exit(_m.main())
 
 from . import baseline as bl
-from . import lint_files
+from . import lint_files_ex
 from .common import PASSES, RULES
 from .config import BASELINE_PATH, TARGET_DIRS
 
@@ -69,7 +69,8 @@ def main(argv=None) -> int:
 
     t0 = time.time()
     rels = _discover(args.roots or list(TARGET_DIRS))
-    findings = lint_files(REPO, rels, only=args.passes)
+    report = lint_files_ex(REPO, rels, only=args.passes)
+    findings = report.findings
 
     if args.write_baseline:
         bl.save(args.baseline, findings)
@@ -95,10 +96,24 @@ def main(argv=None) -> int:
         rc = 1
     if new:
         rc = 1
+    # inline-suppression visibility: a suppressed finding used to vanish
+    # without a trace; report the per-rule tally and flag comments that no
+    # longer suppress anything (dead — prune them)
+    if report.suppressed:
+        by_rule: dict = {}
+        for f in report.suppressed:
+            by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+        tally = ", ".join(f"{r}: {n}" for r, n in sorted(by_rule.items()))
+        print(f"jaxlint: suppressed: {len(report.suppressed)} finding(s) "
+              f"by rule ({tally})")
+    for path, line, rule in report.dead:
+        where = f"{path}:{line}" if line else f"{path} (file-wide)"
+        print(f"jaxlint: warning: dead suppression {where}: {rule} "
+              f"suppresses nothing — prune it", file=sys.stderr)
     dt = time.time() - t0
     print(f"jaxlint: {len(rels)} files, {len(findings)} finding(s) "
-          f"({len(new)} new, {len(findings) - len(new)} baselined) "
-          f"in {dt:.1f}s")
+          f"({len(new)} new, {len(findings) - len(new)} baselined, "
+          f"{len(report.suppressed)} suppressed) in {dt:.1f}s")
     return rc
 
 

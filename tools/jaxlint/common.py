@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import re
 from dataclasses import dataclass
-from typing import Dict, List, Set, Tuple
+from typing import Dict, List, NamedTuple, Set, Tuple
 
 _DISABLE_RE = re.compile(r"#\s*jaxlint:\s*disable(-file)?(?:=([\w\-, ]+))?")
 
@@ -94,14 +94,43 @@ def parse_suppressions(source: str) -> Tuple[Dict[int, Set[str]], Set[str]]:
     return per_line, per_file
 
 
-def apply_suppressions(findings: List[Finding], source: str) -> List[Finding]:
+class SuppressionReport(NamedTuple):
+    """apply_suppressions_ex result: what survived, what a comment ate, and
+    which declared suppressions matched nothing (dead — prune them).
+    ``dead`` entries are (line, rule) with line 0 for disable-file scope."""
+
+    kept: List[Finding]
+    suppressed: List[Finding]
+    dead: List[Tuple[int, str]]
+
+
+def apply_suppressions_ex(findings: List[Finding],
+                          source: str) -> SuppressionReport:
     per_line, per_file = parse_suppressions(source)
-    out = []
+    kept: List[Finding] = []
+    suppressed: List[Finding] = []
+    used: set = set()          # (line-or-0, rule-or-"*") that matched
     for f in findings:
         if "*" in per_file or f.rule in per_file:
+            used.add((0, "*" if "*" in per_file else f.rule))
+            suppressed.append(f)
             continue
         sup = per_line.get(f.line, ())
         if "*" in sup or f.rule in sup:
+            used.add((f.line, "*" if "*" in sup else f.rule))
+            suppressed.append(f)
             continue
-        out.append(f)
-    return out
+        kept.append(f)
+    dead: List[Tuple[int, str]] = []
+    for rule in sorted(per_file):
+        if (0, rule) not in used:
+            dead.append((0, rule))
+    for line in sorted(per_line):
+        for rule in sorted(per_line[line]):
+            if (line, rule) not in used:
+                dead.append((line, rule))
+    return SuppressionReport(kept=kept, suppressed=suppressed, dead=dead)
+
+
+def apply_suppressions(findings: List[Finding], source: str) -> List[Finding]:
+    return apply_suppressions_ex(findings, source).kept
