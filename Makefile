@@ -7,7 +7,7 @@ CXX ?= g++
 CXXFLAGS ?= -O2 -std=c++17 -fPIC -Wall
 NATIVE_LIB := cluster_capacity_tpu/models/libccsnap.so
 
-.PHONY: all build native lint test-unit test-parity test-fuzz test-dist test-integration test-e2e bench chaos clean verify-native ci
+.PHONY: all build native lint test-unit test-parity test-fuzz test-dist test-integration test-e2e bench perfgate chaos clean verify-native ci
 
 all: build
 
@@ -68,6 +68,13 @@ test-e2e:
 
 bench:
 	$(PY) bench.py
+
+# Throughput regression gate: latest committed BENCH_r*.json vs the pinned
+# floors in tools/perfgate/pins.json (the perf counterpart of irgate's
+# static cost budgets; regenerate with `python -m tools.perfgate
+# --update-pins` and review the diff).
+perfgate:
+	$(PY) -m tools.perfgate
 
 # Full CI pipeline: lint + native + default suite + fuzz slice +
 # integration + multichip dryrun, as configured in ci.yaml (the

@@ -72,6 +72,16 @@ def build_parser(prog: str = "cluster-capacity") -> argparse.ArgumentParser:
     p.add_argument("--metrics", action="store_true",
                    help="Dump scheduler metrics (Prometheus text format) to "
                         "stderr after the run.")
+    p.add_argument("--metrics-dump", dest="metrics_dump", default="",
+                   metavar="FILE",
+                   help="Write the full metrics registry (Prometheus text "
+                        "format, including the cc_* site×rung telemetry) to "
+                        "FILE after the run ('-' = stdout).")
+    p.add_argument("--trace-out", dest="trace_out", default="",
+                   metavar="FILE",
+                   help="Write collected telemetry spans as Chrome-trace-"
+                        "event JSONL (loadable in Perfetto / chrome://"
+                        "tracing) to FILE after the run ('-' = stdout).")
     p.add_argument("--period", type=float, default=0.0,
                    help="Continuous mode: re-sync and re-run the analysis "
                         "every PERIOD seconds (the reference's historical "
@@ -175,6 +185,10 @@ def run(argv: Optional[List[str]] = None, prog: str = "cluster-capacity") -> int
     if args.trace:
         from ..utils.trace import default_tracer
         default_tracer.enable()
+    if args.metrics_dump or args.trace_out:
+        # recompile accounting only makes sense when telemetry is surfaced
+        from .. import obs
+        obs.install_recompile_hook()
 
     exclude = [s for s in args.exclude_nodes.split(",") if s]
 
@@ -320,6 +334,15 @@ def run(argv: Optional[List[str]] = None, prog: str = "cluster-capacity") -> int
             break
         sys.stdout.flush()
         time.sleep(args.period)
+    if args.metrics_dump or args.trace_out:
+        from .. import obs
+        if args.metrics_dump:
+            obs.write_metrics(args.metrics_dump)
+        if args.trace_out:
+            n = obs.write_trace(args.trace_out)
+            if args.trace_out != "-":
+                print(f"trace: {n} span(s) written to {args.trace_out}",
+                      file=sys.stderr)
     if args.strict and any_degraded:
         print("Error: --strict and at least one solve was served by a "
               "degraded ladder rung", file=sys.stderr)

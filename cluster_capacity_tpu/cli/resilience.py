@@ -94,6 +94,18 @@ def build_parser(prog: str = "resilience") -> argparse.ArgumentParser:
                    help="Exit nonzero (status 3) when any scenario was "
                         "served by a degraded ladder rung instead of the "
                         "healthy device path.")
+    p.add_argument("--metrics-dump", dest="metrics_dump", default="",
+                   metavar="FILE",
+                   help="Write the metrics registry (Prometheus text format, "
+                        "including the cc_* site×rung telemetry and sweep "
+                        "progress gauges) to FILE after the sweep "
+                        "('-' = stdout).")
+    p.add_argument("--trace-out", dest="trace_out", default="",
+                   metavar="FILE",
+                   help="Write collected telemetry spans as Chrome-trace-"
+                        "event JSONL (Perfetto-loadable; a fault-injected "
+                        "sweep shows its degradation path rung-by-rung) to "
+                        "FILE after the sweep ('-' = stdout).")
     return p
 
 
@@ -124,6 +136,11 @@ def run(argv: Optional[List[str]] = None, prog: str = "resilience") -> int:
         except ValueError as e:
             print(f"Error: {e}", file=sys.stderr)
             return 1
+
+    if args.metrics_dump or args.trace_out:
+        # Count backend compiles while telemetry output was asked for.
+        from .. import obs
+        obs.install_recompile_hook()
 
     if args.podspec:
         probe = default_pod(parse_pod_text(_read_podspec(args.podspec)))
@@ -182,6 +199,15 @@ def run(argv: Optional[List[str]] = None, prog: str = "resilience") -> int:
         print(f"Error: {e}", file=sys.stderr)
         return 1
     print_survivability(report, verbose=args.verbose, fmt=args.output)
+    if args.metrics_dump or args.trace_out:
+        from .. import obs
+        if args.metrics_dump:
+            obs.write_metrics(args.metrics_dump)
+        if args.trace_out:
+            n = obs.write_trace(args.trace_out)
+            if args.trace_out != "-":
+                print(f"trace: {n} span(s) written to {args.trace_out}",
+                      file=sys.stderr)
     if args.strict and report.degraded:
         print("Error: --strict and at least one scenario was served by a "
               "degraded ladder rung", file=sys.stderr)

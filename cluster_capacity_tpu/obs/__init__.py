@@ -1,0 +1,29 @@
+"""obs/: solve telemetry — spans, runtime metrics, trace export.
+
+Three sinks fed from one choke point (runtime/guard.run, the dispatch
+boundary irgate's GD001 audit proves every device call crosses):
+
+1. metrics — the upgraded utils/metrics.Registry: site×rung duration
+   histograms, outcome/degradation/fault-injection counters, sweep progress
+   gauges, and a backend-recompile counter (obs/recompile.py);
+2. spans — nested, bounded, always-on (obs/spans.py), exported as
+   Chrome-trace-event/Perfetto JSONL (obs/export.py);
+3. CLI surfaces — `--metrics-dump` (Prometheus text) and `--trace-out`
+   (trace JSONL) on both CLIs, plus the jax.profiler bridge that
+   utils/trace.Tracer already carries for deep dives.
+
+Import discipline: obs imports only utils and stdlib — runtime/ imports obs,
+never the reverse.  Nothing in this package touches a jax value, so it can
+never force a device sync inside a jit boundary (jaxlint's host-sync rules
+police this: obs/ is a hot dir).
+"""
+
+from . import names
+from .spans import (Collector, Span, default_collector, guard_span,  # noqa: F401
+                    span)
+from .export import trace_events, write_metrics, write_trace  # noqa: F401
+from .recompile import install_recompile_hook  # noqa: F401
+
+__all__ = ["names", "Collector", "Span", "default_collector", "guard_span",
+           "span", "trace_events", "write_metrics", "write_trace",
+           "install_recompile_hook"]

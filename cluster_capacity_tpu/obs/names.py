@@ -1,0 +1,38 @@
+"""The cc_* telemetry metric vocabulary (one place, so dashboards, tests
+and the Prometheus rendering agree on names and label sets).
+
+Counters:
+    cc_guard_runs_total{site,rung,phase,outcome}  every guard.run dispatch;
+        outcome is "ok" or the RuntimeFault code (DeviceOOM, CompileTimeout,
+        ExecuteTimeout, NumericCorruption) or the raw exception type name
+    cc_guard_first_calls_total{site}              first dispatch per site —
+        the compile-vs-execute split marker for cached-executable paths
+    cc_degradations_total{site,fault,to_rung}     ladder transitions
+        (runtime/degrade.py _record)
+    cc_faults_injected_total{site,kind}           chaos harness firings
+    cc_recompiles_total                           backend_compile events from
+        jax.monitoring (see obs/recompile.py: internal jits fire too, so
+        this is an upper bound on user-visible retraces)
+    cc_compile_seconds_total                      backend compile seconds
+    cc_trace_spans_dropped_total                  span-buffer overflow
+
+Gauges:
+    cc_sweep_templates                    templates in the current sweep
+    cc_sweep_groups{mode}                 batched/fast_path/sequential groups
+    cc_resilience_scenarios{state}        total/completed scenario progress
+
+Histograms:
+    cc_guard_run_duration_seconds{site,rung,phase}   per-dispatch wall time
+"""
+
+GUARD_RUNS = "cc_guard_runs_total"
+GUARD_FIRST_CALLS = "cc_guard_first_calls_total"
+GUARD_DURATION = "cc_guard_run_duration_seconds"
+DEGRADATIONS = "cc_degradations_total"
+FAULTS_INJECTED = "cc_faults_injected_total"
+RECOMPILES = "cc_recompiles_total"
+COMPILE_SECONDS = "cc_compile_seconds_total"
+SPANS_DROPPED = "cc_trace_spans_dropped_total"
+SWEEP_TEMPLATES = "cc_sweep_templates"
+SWEEP_GROUPS = "cc_sweep_groups"
+SCENARIOS = "cc_resilience_scenarios"

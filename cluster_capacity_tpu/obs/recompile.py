@@ -1,0 +1,58 @@
+"""Recompile counter: jax.monitoring → registry + span attribution.
+
+jax fires a `/jax/core/compile/backend_compile_duration` duration event for
+every backend compile (jax 0.4.x, jax/_src/monitoring.py).  The listener
+increments cc_recompiles_total / cc_compile_seconds_total and attributes the
+compile seconds to the innermost open *sited* span, which is how a guard
+span's wall time splits into compile vs execute even on the first call of a
+cached executable.
+
+Caveats, by design:
+- internal jits (device_put paths, donation shims) also fire, so the counter
+  is an upper bound on user-visible retraces — a *signal* for perfgate and
+  the zero-recompile invariant, not an exact retrace count;
+- jax.monitoring has no per-listener deregistration, so installation is
+  one-shot per process and opt-in (CLIs install it for --metrics-dump/
+  --trace-out runs, bench children always do).  The listener itself is a
+  few dict ops; it never touches device values.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..utils import metrics as metrics_mod
+from . import names
+from . import spans as spans_mod
+
+_EVENT = "/jax/core/compile/backend_compile_duration"
+_lock = threading.Lock()
+_installed = False
+
+
+def install_recompile_hook(registry=None) -> bool:
+    """Register the backend-compile listener once; returns True when this
+    call did the installation."""
+    global _installed
+    with _lock:
+        if _installed:
+            return False
+        _installed = True
+    reg = registry or metrics_mod.default_registry
+    import jax
+
+    def _on_event_duration(event: str, duration: float, **kw) -> None:
+        if event != _EVENT:
+            return
+        reg.inc(names.RECOMPILES)
+        reg.inc(names.COMPILE_SECONDS, duration)
+        sp = spans_mod.default_collector.active_sited()
+        if sp is not None:
+            sp.compile_s += duration
+
+    jax.monitoring.register_event_duration_secs_listener(_on_event_duration)
+    return True
+
+
+def installed() -> bool:
+    return _installed

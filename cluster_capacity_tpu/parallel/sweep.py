@@ -193,6 +193,16 @@ def sweep(snapshot: ClusterSnapshot, templates: Sequence[dict],
     from ..runtime import degrade, faults, guard
     from ..runtime.errors import RuntimeFault
 
+    # Sweep progress gauges (obs/names.py): how the template set split
+    # across solve modes — sequential count is refreshed below once
+    # singleton groups fold into rest_idx.
+    from ..obs import names as obs_names
+    from ..utils.metrics import default_registry as _registry
+    _registry.set_gauge(obs_names.SWEEP_TEMPLATES, len(templates))
+    _registry.set_gauge(obs_names.SWEEP_GROUPS, len(fp_groups),
+                        mode="fast_path")
+    _registry.set_gauge(obs_names.SWEEP_GROUPS, len(groups), mode="batched")
+
     for _key, idxs in fp_groups.items():
         if len(idxs) == 1:
             rest_idx.append(idxs[0])
@@ -202,7 +212,8 @@ def sweep(snapshot: ClusterSnapshot, templates: Sequence[dict],
                 lambda idxs=idxs: fast_path.solve_fast_batched(
                     [problems[i] for i in idxs], max_limit),
                 site=faults.SITE_FAST_PATH,
-                validate_nodes=snapshot.num_nodes)
+                validate_nodes=snapshot.num_nodes,
+                rung=degrade.RUNG_FAST_PATH, batch=len(idxs))
         except RuntimeFault:
             # batched analytic kernel faulted: the per-template ladder
             # below serves these, flagged degraded
@@ -230,6 +241,8 @@ def sweep(snapshot: ClusterSnapshot, templates: Sequence[dict],
         for i, r in zip(idxs, batch_results):
             results[i] = r
 
+    _registry.set_gauge(obs_names.SWEEP_GROUPS, len(rest_idx),
+                        mode="sequential")
     for i in rest_idx:
         results[i] = degrade.solve_one_guarded(problems[i],
                                                max_limit=max_limit)

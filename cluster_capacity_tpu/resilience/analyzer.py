@@ -400,6 +400,19 @@ def analyze(snapshot: ClusterSnapshot, scenarios: Sequence[FailureScenario],
         results[si] = row
     todo = [si for si in rep_set if si not in loaded]
 
+    # Sweep progress gauges: total/representative/completed scenario counts
+    # (completed starts at the journal-resumed count and ticks per row, so a
+    # watcher can read sweep progress off --metrics-dump mid-run).
+    from .. import obs
+    from ..obs import names as obs_names
+    from ..utils.metrics import default_registry as _registry
+    _registry.set_gauge(obs_names.SCENARIOS, len(scenarios), state="total")
+    _registry.set_gauge(obs_names.SCENARIOS, len(rep_set),
+                        state="representative")
+    done_count = [len(loaded)]
+    _registry.set_gauge(obs_names.SCENARIOS, done_count[0],
+                        state="completed")
+
     def _complete(si: int, r: sim.SolveResult, *, was_batched: bool,
                   node_names: List[str]) -> None:
         """Assemble a scenario's row and journal it IMMEDIATELY — a sweep
@@ -418,6 +431,9 @@ def analyze(snapshot: ClusterSnapshot, scenarios: Sequence[FailureScenario],
             degraded=getattr(r, "degraded", False))
         results[si] = row
         _journal(row)
+        done_count[0] += 1
+        _registry.set_gauge(obs_names.SCENARIOS, done_count[0],
+                            state="completed")
 
     try:
         # --- drain phase (host, sequential — scenarios that lose pods) ----
@@ -472,12 +488,13 @@ def analyze(snapshot: ClusterSnapshot, scenarios: Sequence[FailureScenario],
 
         for si in seq_sis:
             sc = scenarios[si]
-            snap_del = drains[si].final_deleted_snapshot
-            if snap_del is None:
-                snap_del = _delete_nodes(snapshot, sc.failed)
-            r = degrade.solve_one_guarded(
-                enc.encode_problem(snap_del, probe, profile),
-                max_limit=max_limit, degraded=si in seq_degraded)
+            with obs.span("resilience.scenario", scenario=sc.name):
+                snap_del = drains[si].final_deleted_snapshot
+                if snap_del is None:
+                    snap_del = _delete_nodes(snapshot, sc.failed)
+                r = degrade.solve_one_guarded(
+                    enc.encode_problem(snap_del, probe, profile),
+                    max_limit=max_limit, degraded=si in seq_degraded)
             _complete(si, r, was_batched=False,
                       node_names=snap_del.node_names)
     finally:

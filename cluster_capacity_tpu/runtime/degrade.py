@@ -61,7 +61,11 @@ def _stamp(result, rung: str, degraded: bool):
 
 
 def _record(fault: RuntimeFault, next_rung: str) -> None:
+    from ..obs import names as obs_names
     from ..utils.events import default_recorder
+    from ..utils.metrics import default_registry
+    default_registry.inc(obs_names.DEGRADATIONS, site=fault.site or "?",
+                         fault=fault.code, to_rung=next_rung)
     default_recorder.eventf(
         "solve", EVENT_DEGRADED,
         f"{fault.code} at {fault.site or '?'}: falling back to "
@@ -125,43 +129,48 @@ def solve_one_guarded(pb, max_limit: int = 0, *, deadline: float = 0.0,
     (transient device errors); `degraded` pre-marks the result when the
     caller already fell off a higher rung."""
     from ..engine import fast_path
+    from .. import obs
 
     n = pb.snapshot.num_nodes
     masked = pb.num_alive != n
 
-    def _attempt(fn, site, phase):
+    def _attempt(fn, site, phase, rung):
         last: Optional[RuntimeFault] = None
         for _ in range(retries + 1):
             try:
                 return guard.run(fn, site=site, deadline=deadline,
-                                 phase=phase, validate_nodes=n), None
+                                 phase=phase, validate_nodes=n,
+                                 rung=rung), None
             except RuntimeFault as fault:
                 last = fault
         return None, last
 
-    result, fault = _attempt(
-        lambda: fast_path.solve_auto(pb, max_limit=max_limit),
-        SITE_SOLVE, guard.PHASE_EXECUTE)
-    if fault is None:
-        return _stamp(result, RUNG_FUSED, degraded)
+    with obs.span("degrade.solve_one"):
+        result, fault = _attempt(
+            lambda: fast_path.solve_auto(pb, max_limit=max_limit),
+            SITE_SOLVE, guard.PHASE_EXECUTE, RUNG_FUSED)
+        if fault is None:
+            return _stamp(result, RUNG_FUSED, degraded)
 
-    _record(fault, RUNG_FAST_PATH)
-    result, fp_fault = _attempt(
-        lambda: fast_path.solve_fast(pb, max_limit=max_limit),
-        SITE_FAST_PATH, guard.PHASE_EXECUTE)
-    if fp_fault is None and result is not None:
-        return _stamp(result, RUNG_FAST_PATH, True)
+        _record(fault, RUNG_FAST_PATH)
+        result, fp_fault = _attempt(
+            lambda: fast_path.solve_fast(pb, max_limit=max_limit),
+            SITE_FAST_PATH, guard.PHASE_EXECUTE, RUNG_FAST_PATH)
+        if fp_fault is None and result is not None:
+            return _stamp(result, RUNG_FAST_PATH, True)
 
-    if masked:
-        # The oracle replays the snapshot and cannot see an alive_mask that
-        # was folded into the encoded problem — callers with masked
-        # problems (resilience sweeps) must fall back at a level where the
-        # mask is still expressible (deleted-snapshot sequential path).
-        raise fault
-    _record(fp_fault or fault, RUNG_ORACLE)
-    result = guard.run(lambda: _solve_oracle(pb, max_limit=max_limit),
-                       site=SITE_ORACLE, validate_nodes=n)
-    return _stamp(result, RUNG_ORACLE, True)
+        if masked:
+            # The oracle replays the snapshot and cannot see an alive_mask
+            # that was folded into the encoded problem — callers with masked
+            # problems (resilience sweeps) must fall back at a level where
+            # the mask is still expressible (deleted-snapshot sequential
+            # path).
+            raise fault
+        _record(fp_fault or fault, RUNG_ORACLE)
+        result = guard.run(lambda: _solve_oracle(pb, max_limit=max_limit),
+                           site=SITE_ORACLE, validate_nodes=n,
+                           rung=RUNG_ORACLE)
+        return _stamp(result, RUNG_ORACLE, True)
 
 
 def solve_group_guarded(pbs, max_limit: int = 0, mesh=None, *,
@@ -171,36 +180,39 @@ def solve_group_guarded(pbs, max_limit: int = 0, mesh=None, *,
     geometrically (independent sub-batches, bit-identical placements) down
     to B=1; other faults — and B=1 OOM — descend to the per-item ladder."""
     from ..parallel import sweep as sweep_mod
+    from .. import obs
 
     if not pbs:
         return []
     n = pbs[0].snapshot.num_nodes
 
-    last: Optional[RuntimeFault] = None
-    for _ in range(retries + 1):
-        try:
-            results = guard.run(
-                lambda: sweep_mod.solve_group(pbs, max_limit=max_limit,
-                                              mesh=mesh),
-                site=SITE_GROUP, deadline=deadline,
-                phase=guard.PHASE_COMPILE, validate_nodes=n)
-            return [_stamp(r, RUNG_BATCHED, degraded) for r in results]
-        except RuntimeFault as fault:
-            last = fault
+    with obs.span("degrade.solve_group", batch=len(pbs)):
+        last: Optional[RuntimeFault] = None
+        for _ in range(retries + 1):
+            try:
+                results = guard.run(
+                    lambda: sweep_mod.solve_group(pbs, max_limit=max_limit,
+                                                  mesh=mesh),
+                    site=SITE_GROUP, deadline=deadline,
+                    phase=guard.PHASE_COMPILE, validate_nodes=n,
+                    rung=RUNG_BATCHED, batch=len(pbs))
+                return [_stamp(r, RUNG_BATCHED, degraded) for r in results]
+            except RuntimeFault as fault:
+                last = fault
 
-    from .errors import DeviceOOM
-    if isinstance(last, DeviceOOM) and len(pbs) > 1:
-        mid = len(pbs) // 2
-        _record(last, f"{RUNG_BATCHED}[{mid}+{len(pbs) - mid}]")
-        left = solve_group_guarded(pbs[:mid], max_limit=max_limit, mesh=mesh,
-                                   deadline=deadline, retries=retries,
-                                   degraded=True)
-        right = solve_group_guarded(pbs[mid:], max_limit=max_limit,
-                                    mesh=mesh, deadline=deadline,
-                                    retries=retries, degraded=True)
-        return left + right
+        from .errors import DeviceOOM
+        if isinstance(last, DeviceOOM) and len(pbs) > 1:
+            mid = len(pbs) // 2
+            _record(last, f"{RUNG_BATCHED}[{mid}+{len(pbs) - mid}]")
+            left = solve_group_guarded(pbs[:mid], max_limit=max_limit,
+                                       mesh=mesh, deadline=deadline,
+                                       retries=retries, degraded=True)
+            right = solve_group_guarded(pbs[mid:], max_limit=max_limit,
+                                        mesh=mesh, deadline=deadline,
+                                        retries=retries, degraded=True)
+            return left + right
 
-    _record(last, RUNG_FUSED)
-    return [solve_one_guarded(pb, max_limit=max_limit, deadline=deadline,
-                              retries=retries, degraded=True)
-            for pb in pbs]
+        _record(last, RUNG_FUSED)
+        return [solve_one_guarded(pb, max_limit=max_limit, deadline=deadline,
+                                  retries=retries, degraded=True)
+                for pb in pbs]

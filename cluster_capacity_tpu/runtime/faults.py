@@ -179,6 +179,10 @@ def fire(site: str) -> Optional[FaultSpec]:
     spec = active_fault(site)
     if spec is None:
         return None
+    from ..obs import names as obs_names
+    from ..utils.metrics import default_registry
+    default_registry.inc(obs_names.FAULTS_INJECTED, site=site,
+                         kind=spec.kind)
     if spec.kind == KIND_OOM:
         raise SimulatedDeviceError(
             f"RESOURCE_EXHAUSTED: Out of memory while trying to allocate "

@@ -9,6 +9,11 @@
    bug, and degrading would hide it), and
 4. applies injected output corruption, then validates the result planes.
 
+Being the single choke point also makes it the telemetry tap: every call is
+wrapped in an obs/ span (site, rung, phase, batch, outcome, compile split)
+feeding the site×rung metrics, and every classified fault is stamped into
+the event recorder before it propagates.
+
 Deadline mechanics: JAX dispatch cannot be interrupted from Python, so the
 call runs in a daemon thread and on timeout the thread is *abandoned* — it
 may still complete in the background, but its result is discarded and the
@@ -25,7 +30,7 @@ from typing import Iterable, Optional
 
 from . import faults
 from .errors import (CompileTimeout, DeviceOOM, ExecuteTimeout,
-                     NumericCorruption)
+                     NumericCorruption, RuntimeFault)
 
 PHASE_COMPILE = "compile"
 PHASE_EXECUTE = "execute"
@@ -111,34 +116,56 @@ def _deadline_call(fn, args, kwargs, deadline: float, *,
     return box.get("result")
 
 
+def _record_fault_event(fault) -> None:
+    """Stamp the classified fault into the event recorder so reports can
+    show WHY a solve degraded (the SolveDegraded event names the transition;
+    this one names the fault itself, with its site and detail)."""
+    from ..utils.events import default_recorder
+    default_recorder.eventf("device", fault.code, str(fault))
+
+
 def run(fn, *args, site: str, deadline: float = 0.0,
         phase: str = PHASE_EXECUTE,
-        validate_nodes: Optional[int] = None, **kwargs):
+        validate_nodes: Optional[int] = None,
+        rung: str = "", batch: Optional[int] = None, **kwargs):
     """Execute `fn(*args, **kwargs)` under the watchdog.
 
     Raises DeviceOOM / CompileTimeout / ExecuteTimeout / NumericCorruption
     for recoverable faults; anything else propagates untouched.
+
+    `rung` and `batch` only annotate telemetry (obs/): every call gets a
+    span stamped with site/rung/phase/batch and the outcome, feeding the
+    site×rung metrics; an omitted rung inherits from the enclosing span.
+    Both names are reserved — they are never forwarded to `fn`.
     """
-    try:
-        corrupt_spec = faults.fire(site)  # may raise simulated oom/hang
-        if deadline and deadline > 0:
-            result = _deadline_call(fn, args, kwargs, deadline,
-                                    site=site, phase=phase)
-        else:
-            result = fn(*args, **kwargs)
-    except faults.SimulatedHang as exc:
-        fault = CompileTimeout if phase == PHASE_COMPILE else ExecuteTimeout
-        raise fault(str(exc), site=site) from exc
-    except Exception as exc:
-        fault = classify_device_error(exc, site=site, phase=phase)
-        if fault is not None:
-            raise fault from exc
-        raise
-    result = faults.maybe_corrupt(corrupt_spec, result)
-    if validate_nodes is not None:
-        if isinstance(result, (list, tuple)):
-            for item in result:
-                validate_result(item, validate_nodes, site=site)
-        else:
-            validate_result(result, validate_nodes, site=site)
-    return result
+    from .. import obs
+
+    with obs.guard_span(site=site, phase=phase, rung=rung, batch=batch):
+        try:
+            try:
+                corrupt_spec = faults.fire(site)  # may raise simulated oom/hang
+                if deadline and deadline > 0:
+                    result = _deadline_call(fn, args, kwargs, deadline,
+                                            site=site, phase=phase)
+                else:
+                    result = fn(*args, **kwargs)
+            except faults.SimulatedHang as exc:
+                fault = CompileTimeout if phase == PHASE_COMPILE \
+                    else ExecuteTimeout
+                raise fault(str(exc), site=site) from exc
+            except Exception as exc:
+                fault = classify_device_error(exc, site=site, phase=phase)
+                if fault is not None:
+                    raise fault from exc
+                raise
+            result = faults.maybe_corrupt(corrupt_spec, result)
+            if validate_nodes is not None:
+                if isinstance(result, (list, tuple)):
+                    for item in result:
+                        validate_result(item, validate_nodes, site=site)
+                else:
+                    validate_result(result, validate_nodes, site=site)
+            return result
+        except RuntimeFault as fault:
+            _record_fault_event(fault)
+            raise

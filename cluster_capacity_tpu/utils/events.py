@@ -27,21 +27,30 @@ class Event:
 
 @dataclass
 class Recorder:
+    """Bounded ring: always retains exactly the newest `max_events` events
+    once full (the old trimming dropped the oldest HALF on overflow, so the
+    retained window silently jumped by max_events/2; `dropped` counts what
+    the ring has evicted over its lifetime)."""
+
     max_events: int = 10000
     events: List[Event] = field(default_factory=list)
+    dropped: int = 0
 
     def eventf(self, object_name: str, reason: str, message: str) -> None:
-        if len(self.events) >= self.max_events:
-            del self.events[: self.max_events // 2]
         self.events.append(Event(reason=reason, message=message,
                                  object_name=object_name,
                                  timestamp=time.time()))
+        overflow = len(self.events) - self.max_events
+        if overflow > 0:
+            del self.events[:overflow]
+            self.dropped += overflow
 
     def by_reason(self, reason: str) -> List[Event]:
         return [e for e in self.events if e.reason == reason]
 
     def clear(self) -> None:
         self.events.clear()
+        self.dropped = 0
 
 
 default_recorder = Recorder()

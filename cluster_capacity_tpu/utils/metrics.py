@@ -6,6 +6,12 @@ cluster-capacity nils out SecureServing, pkg/utils/utils.go:127-130).  This
 module keeps the same observable names as in-process counters/histograms and
 can render them in Prometheus text exposition format on demand — strictly more
 usable than the reference (which black-holes them) with the same vocabulary.
+
+Since the obs/ telemetry layer, the registry also carries labeled histograms
+(per site×rung guard latencies) and gauges (sweep/scenario progress).  All
+series are keyed (name, sorted-label-tuple); rendering is deterministic so
+golden tests can pin the exact exposition text.  Everything here is host-side
+Python — no series update ever touches a jax value or forces a device sync.
 """
 
 from __future__ import annotations
@@ -13,6 +19,12 @@ from __future__ import annotations
 import threading
 from collections import defaultdict
 from typing import Dict, List, Tuple
+
+LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _label_key(labels: dict) -> LabelKey:
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
 
 
 class _Histogram:
@@ -39,50 +51,76 @@ _LATENCY_BUCKETS = (0.001, 0.002, 0.004, 0.008, 0.016, 0.032, 0.064, 0.128,
 
 
 class Registry:
-    """Counter + histogram registry mirroring the scheduler metric names."""
+    """Counter + gauge + histogram registry mirroring the scheduler metric
+    names (plus the cc_* telemetry vocabulary from obs/names.py)."""
 
     def __init__(self):
         self._lock = threading.Lock()
-        self.counters: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = \
-            defaultdict(float)
-        self.histograms: Dict[str, _Histogram] = {}
+        self.counters: Dict[Tuple[str, LabelKey], float] = defaultdict(float)
+        self.gauges: Dict[Tuple[str, LabelKey], float] = {}
+        self.histograms: Dict[Tuple[str, LabelKey], _Histogram] = {}
 
     def inc(self, name: str, amount: float = 1.0, **labels) -> None:
-        key = (name, tuple(sorted(labels.items())))
+        key = (name, _label_key(labels))
         with self._lock:
             self.counters[key] += amount
 
-    def observe(self, name: str, value: float) -> None:
+    def set_gauge(self, name: str, value: float, **labels) -> None:
+        key = (name, _label_key(labels))
         with self._lock:
-            h = self.histograms.get(name)
+            self.gauges[key] = float(value)
+
+    def observe(self, name: str, value: float, **labels) -> None:
+        key = (name, _label_key(labels))
+        with self._lock:
+            h = self.histograms.get(key)
             if h is None:
-                h = self.histograms[name] = _Histogram(_LATENCY_BUCKETS)
+                h = self.histograms[key] = _Histogram(_LATENCY_BUCKETS)
             h.observe(value)
 
     def get(self, name: str, **labels) -> float:
-        return self.counters.get((name, tuple(sorted(labels.items()))), 0.0)
+        return self.counters.get((name, _label_key(labels)), 0.0)
+
+    def get_gauge(self, name: str, **labels) -> float:
+        return self.gauges.get((name, _label_key(labels)), 0.0)
+
+    def counter_total(self, name: str) -> float:
+        """Sum of a counter across all label sets."""
+        with self._lock:
+            return sum(v for (n, _), v in self.counters.items() if n == name)
 
     def render(self) -> str:
-        """Prometheus text exposition format."""
+        """Prometheus text exposition format (deterministic ordering:
+        counters, then gauges, then histograms, each sorted by name+labels;
+        histogram labels sorted with `le` last)."""
         lines: List[str] = []
+
+        def _fmt(name: str, labels: LabelKey, value) -> str:
+            label_s = ",".join(f'{k}="{v}"' for k, v in labels)
+            body = f"{name}{{{label_s}}}" if label_s else name
+            return f"{body} {value:g}"
+
         with self._lock:
             for (name, labels), v in sorted(self.counters.items()):
-                label_s = ",".join(f'{k}="{val}"' for k, val in labels)
-                lines.append(f"{name}{{{label_s}}} {v:g}" if label_s
-                             else f"{name} {v:g}")
-            for name, h in sorted(self.histograms.items()):
+                lines.append(_fmt(name, labels, v))
+            for (name, labels), v in sorted(self.gauges.items()):
+                lines.append(_fmt(name, labels, v))
+            for (name, labels), h in sorted(self.histograms.items()):
                 acc = 0
                 for b, c in zip(h.buckets, h.counts):
                     acc += c
-                    lines.append(f'{name}_bucket{{le="{b:g}"}} {acc}')
-                lines.append(f'{name}_bucket{{le="+Inf"}} {h.count}')
-                lines.append(f"{name}_sum {h.total:g}")
-                lines.append(f"{name}_count {h.count}")
+                    lines.append(_fmt(f"{name}_bucket",
+                                      labels + (("le", f"{b:g}"),), acc))
+                lines.append(_fmt(f"{name}_bucket",
+                                  labels + (("le", "+Inf"),), h.count))
+                lines.append(_fmt(f"{name}_sum", labels, h.total))
+                lines.append(_fmt(f"{name}_count", labels, h.count))
         return "\n".join(lines) + ("\n" if lines else "")
 
     def reset(self) -> None:
         with self._lock:
             self.counters.clear()
+            self.gauges.clear()
             self.histograms.clear()
 
 

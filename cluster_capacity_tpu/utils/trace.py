@@ -44,15 +44,22 @@ class Tracer:
 
     @contextlib.contextmanager
     def span(self, name: str):
+        # Always feed the obs/ span collector (it is bounded and ~free), so
+        # --trace-out captures the snapshot/solve phase spans even when the
+        # stderr printer below is off; the Tracer's own list + printing stay
+        # gated on enable() as before.
+        from ..obs.spans import default_collector
         if not self.enabled:
-            yield
+            with default_collector.span(name):
+                yield
             return
         s = Span(name=name, start=time.perf_counter())
         if len(self.spans) >= 1000:        # bound long-lived processes
             del self.spans[:500]
         self.spans.append(s)
         try:
-            yield
+            with default_collector.span(name):
+                yield
         finally:
             s.duration = time.perf_counter() - s.start
             if s.duration >= self.threshold_s:

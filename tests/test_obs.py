@@ -1,0 +1,377 @@
+"""Solve telemetry suite (cluster_capacity_tpu/obs/ + tools/perfgate/).
+
+Invariants under test: every ladder rung attempted under injected faults
+leaves a correctly-attributed span (site, rung, outcome, parentage); the
+metrics registry renders deterministic Prometheus text (golden-pinned); the
+event recorder ring retains exactly the newest max_events; trace export is
+valid Chrome-trace-event JSONL; and the perfgate throughput gate fails a
+doctored bench artifact naming the metric and the delta (including the real
+r04→r05 fast_path regression from the committed artifacts).
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+from cluster_capacity_tpu import SchedulerProfile, obs
+from cluster_capacity_tpu.engine import encode as enc
+from cluster_capacity_tpu.models.podspec import default_pod
+from cluster_capacity_tpu.models.snapshot import ClusterSnapshot
+from cluster_capacity_tpu.obs import names as obs_names
+from cluster_capacity_tpu.runtime import degrade, faults
+from cluster_capacity_tpu.utils import metrics
+from cluster_capacity_tpu.utils.events import Recorder, default_recorder
+from cluster_capacity_tpu.utils.metrics import default_registry
+
+from helpers import build_test_node, build_test_pod
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+from tools.perfgate import gate as pg  # noqa: E402
+from tools.perfgate.__main__ import main as perfgate_main  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    faults.clear()
+    obs.default_collector.reset()
+    default_registry.reset()
+    default_recorder.clear()
+    yield
+    faults.clear()
+    obs.default_collector.reset()
+    default_registry.reset()
+    default_recorder.clear()
+
+
+def _pb(num_nodes=4, cpu=2000, pods=8):
+    nodes = [build_test_node(f"n{i}", cpu, 4 * 1024 ** 3, pods)
+             for i in range(num_nodes)]
+    snap = ClusterSnapshot.from_objects(nodes)
+    return enc.encode_problem(snap, default_pod(build_test_pod("probe", 500)),
+                              SchedulerProfile())
+
+
+# --- span collection ---------------------------------------------------------
+
+def test_ladder_descent_leaves_span_per_rung():
+    """oom at fused + fast_path rungs → one parent degrade span with a
+    child guard span per rung attempted, each stamped with the fault code
+    that ended it; the serving oracle span closes ok."""
+    with faults.inject("engine.solve:oom", "engine.fast_path:oom"):
+        res = degrade.solve_one_guarded(_pb())
+    assert res.rung == degrade.RUNG_ORACLE and res.degraded
+
+    spans = {s.name: s for s in obs.default_collector.spans()}
+    parent = spans["degrade.solve_one"]
+    assert parent.outcome == "ok"
+
+    solve = spans["guard:engine.solve"]
+    assert (solve.rung, solve.outcome) == (degrade.RUNG_FUSED, "DeviceOOM")
+    assert solve.first_call and solve.parent_id == parent.span_id
+
+    fp = spans["guard:engine.fast_path"]
+    assert (fp.rung, fp.outcome) == (degrade.RUNG_FAST_PATH, "DeviceOOM")
+    assert fp.parent_id == parent.span_id
+
+    oracle = spans["guard:engine.oracle"]
+    assert (oracle.rung, oracle.outcome) == (degrade.RUNG_ORACLE, "ok")
+    assert oracle.parent_id == parent.span_id
+    assert all(s.duration_s is not None for s in (solve, fp, oracle))
+
+    # metric sinks saw the same story
+    assert default_registry.get(
+        obs_names.FAULTS_INJECTED, site="engine.solve", kind="oom") == 1
+    assert default_registry.get(
+        obs_names.DEGRADATIONS, site="engine.solve", fault="DeviceOOM",
+        to_rung=degrade.RUNG_FAST_PATH) == 1
+    assert default_registry.get(
+        obs_names.GUARD_RUNS, site="engine.oracle",
+        rung=degrade.RUNG_ORACLE, phase="execute", outcome="ok") == 1
+    # fault events landed in the recorder alongside the transitions
+    assert default_recorder.by_reason("DeviceOOM")
+    assert default_recorder.by_reason("SolveDegraded")
+
+
+def test_rung_inheritance_and_first_call():
+    c = obs.Collector()
+    with c.span("outer", rung="fused"):
+        with c.span("inner", site="x.y"):
+            pass
+        with c.span("inner2", site="x.y"):
+            pass
+    inner, inner2 = [s for s in c.spans() if s.name.startswith("inner")]
+    assert inner.rung == "fused"          # inherited from enclosing span
+    assert inner.first_call and not inner2.first_call
+
+
+def test_span_buffer_bounded():
+    c = obs.Collector(max_spans=8)
+    for i in range(20):
+        with c.span(f"s{i}"):
+            pass
+    spans = c.spans()
+    assert len(spans) == 8 and c.dropped == 12
+    assert spans[-1].name == "s19"        # newest retained
+
+
+def test_guard_span_outcome_and_histogram():
+    with pytest.raises(ValueError):
+        with obs.guard_span(site="t.site", phase="execute", rung="fused"):
+            raise ValueError("boom")
+    assert default_registry.get(
+        obs_names.GUARD_RUNS, site="t.site", rung="fused", phase="execute",
+        outcome="ValueError") == 1
+    # the duration histogram saw exactly one observation for the series
+    key = None
+    for (name, labels) in default_registry.histograms:
+        if name == obs_names.GUARD_DURATION and ("site", "t.site") in labels:
+            key = (name, labels)
+    assert key is not None
+    assert default_registry.histograms[key].count == 1
+
+
+# --- metrics rendering -------------------------------------------------------
+
+def test_prometheus_render_golden():
+    reg = metrics.Registry()
+    reg.inc(obs_names.GUARD_RUNS, outcome="DeviceOOM", site="engine.solve",
+            rung="fused", phase="execute", amount=2.0)
+    reg.inc(obs_names.GUARD_RUNS, outcome="ok", site="engine.solve",
+            rung="fused", phase="execute")
+    reg.set_gauge(obs_names.SWEEP_GROUPS, 3, mode="batched")
+    reg.observe(obs_names.GUARD_DURATION, 0.0015, site="engine.solve",
+                rung="fused", phase="execute")
+    reg.observe(obs_names.GUARD_DURATION, 5.0, site="engine.solve",
+                rung="fused", phase="execute")
+
+    hist_labels = 'phase="execute",rung="fused",site="engine.solve"'
+    bucket_counts = [("0.001", 0)] + [
+        (le, 1) for le in ("0.002", "0.004", "0.008", "0.016", "0.032",
+                           "0.064", "0.128", "0.256", "0.512", "1.024",
+                           "2.048", "4.096")] + [("8.192", 2), ("+Inf", 2)]
+    golden = "\n".join(
+        ['cc_guard_runs_total{outcome="DeviceOOM",phase="execute",'
+         'rung="fused",site="engine.solve"} 2',
+         'cc_guard_runs_total{outcome="ok",phase="execute",'
+         'rung="fused",site="engine.solve"} 1',
+         'cc_sweep_groups{mode="batched"} 3'] +
+        [f'cc_guard_run_duration_seconds_bucket{{{hist_labels},le="{le}"}} '
+         f'{c}' for le, c in bucket_counts] +
+        [f'cc_guard_run_duration_seconds_sum{{{hist_labels}}} 5.0015',
+         f'cc_guard_run_duration_seconds_count{{{hist_labels}}} 2']) + "\n"
+    assert reg.render() == golden
+
+
+def test_render_is_valid_prometheus_text():
+    import re
+    with faults.inject("engine.solve:oom"):
+        degrade.solve_one_guarded(_pb())
+    text = default_registry.render()
+    assert "cc_guard_runs_total" in text
+    assert "cc_guard_run_duration_seconds_bucket" in text
+    line_re = re.compile(
+        r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+        r'(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"(,[a-zA-Z_][a-zA-Z0-9_]*='
+        r'"[^"]*")*\})? -?(\d+(\.\d+)?([eE][+-]?\d+)?|\+?Inf|NaN)$')
+    for line in text.splitlines():
+        assert line_re.match(line), f"not Prometheus text: {line!r}"
+
+
+# --- event recorder ring -----------------------------------------------------
+
+def test_recorder_ring_keeps_newest():
+    r = Recorder(max_events=5)
+    for i in range(12):
+        r.eventf("obj", "R", f"e{i}")
+    assert len(r.events) == 5 and r.dropped == 7
+    assert [e.message for e in r.events] == [f"e{i}" for i in range(7, 12)]
+    r.clear()
+    assert not r.events and r.dropped == 0
+
+
+# --- trace export ------------------------------------------------------------
+
+def test_trace_export_jsonl(tmp_path):
+    with faults.inject("engine.solve:oom", "engine.fast_path:oom"):
+        degrade.solve_one_guarded(_pb())
+    out = tmp_path / "trace.jsonl"
+    n = obs.write_trace(str(out))
+    lines = out.read_text().splitlines()
+    assert n == len(lines) >= 4
+    events = [json.loads(ln) for ln in lines]
+    for ev in events:
+        assert ev["ph"] == "X" and ev["pid"] == 1
+        assert isinstance(ev["ts"], float) and isinstance(ev["dur"], float)
+    by_name = {ev["name"]: ev for ev in events}
+    solve = by_name["guard:engine.solve"]
+    assert solve["args"]["site"] == "engine.solve"
+    assert solve["args"]["rung"] == degrade.RUNG_FUSED
+    assert solve["args"]["outcome"] == "DeviceOOM"
+    oracle = by_name["guard:engine.oracle"]
+    assert oracle["args"]["rung"] == degrade.RUNG_ORACLE
+    assert oracle["args"]["parent_id"] == \
+        by_name["degrade.solve_one"]["args"]["span_id"]
+
+
+# --- recompile counter -------------------------------------------------------
+
+def test_recompile_hook_counts_backend_compiles():
+    import jax
+    import jax.numpy as jnp
+
+    obs.install_recompile_hook()
+    before = default_registry.counter_total(obs_names.RECOMPILES)
+    # a fresh lambda is a fresh jit cache entry → guaranteed backend compile
+    f = jax.jit(lambda x: x * 2 + 1)
+    with obs.span("holder", site="test.compile") as sp:
+        f(jnp.ones((3, 5))).block_until_ready()
+    after = default_registry.counter_total(obs_names.RECOMPILES)
+    assert after >= before + 1
+    assert default_registry.counter_total(obs_names.COMPILE_SECONDS) > 0.0
+    # the compile seconds were attributed to the open sited span
+    assert sp.compile_s > 0.0
+
+
+# --- perfgate ----------------------------------------------------------------
+
+def _bench(**over):
+    b = {"metric": "scan_engine_spread_placements_per_sec_10000_nodes",
+         "value": 1000.0, "unit": "placements/s", "platform": "cpu",
+         "fast_path_placements_per_sec": 50000.0,
+         "sweep_spread_nodes": 10000,          # not *_per_sec: never gated
+         "phases": {"fast": {"warmup_s": 1.2, "steady_s": 0.4,
+                             "recompiles": 3, "backend_compile_s": 0.9}}}
+    b.update(over)
+    return b
+
+
+def test_perfgate_clean_on_pin_source():
+    bench = _bench()
+    pins = pg.make_pins(bench, "BENCH_r98.json")
+    assert set(pins["metrics"]) == {
+        "scan_engine_spread_placements_per_sec_10000_nodes",
+        "fast_path_placements_per_sec"}
+    findings, skip = pg.compare(bench, pins)
+    assert findings == [] and skip is None
+    # within the 10% band: still clean
+    findings, _ = pg.compare(
+        _bench(fast_path_placements_per_sec=46000.0), pins)
+    assert findings == []
+
+
+def test_perfgate_regression_names_metric_delta_and_phases():
+    pins = pg.make_pins(_bench(), "BENCH_r98.json")
+    findings, skip = pg.compare(
+        _bench(fast_path_placements_per_sec=40000.0), pins)
+    assert skip is None and len(findings) == 1
+    f = findings[0]
+    assert (f.metric, f.rule) == ("fast_path_placements_per_sec", "PG002")
+    assert "50000.00 -> 40000.00" in f.message
+    assert "-20.0%" in f.message
+    assert "phases[fast]" in f.message and "warmup 1.2s" in f.message
+    assert "recompiles 3" in f.message
+
+
+def test_perfgate_new_and_stale_metrics():
+    pins = pg.make_pins(_bench(), "BENCH_r98.json")
+    grown = _bench(resilience_scenarios_per_sec=12.5)
+    findings, _ = pg.compare(grown, pins)
+    assert [(f.metric, f.rule) for f in findings] == [
+        ("resilience_scenarios_per_sec", "PG001")]
+    shrunk = _bench()
+    del shrunk["fast_path_placements_per_sec"]
+    findings, _ = pg.compare(shrunk, pins)
+    assert [(f.metric, f.rule) for f in findings] == [
+        ("fast_path_placements_per_sec", "PG003")]
+
+
+def test_perfgate_platform_change_skips():
+    pins = pg.make_pins(_bench(), "BENCH_r98.json")
+    findings, skip = pg.compare(_bench(platform="tpu",
+                                       fast_path_placements_per_sec=1.0),
+                                pins)
+    assert findings == [] and "platform changed" in skip
+
+
+def test_perfgate_cli_exit_codes(tmp_path, capsys):
+    pins_path = str(tmp_path / "pins.json")
+    pg.save_pins(pg.make_pins(_bench(), "BENCH_r98.json"), pins_path)
+    # doctored artifact, wrapped in the driver envelope ({"parsed": ...})
+    doctored = str(tmp_path / "BENCH_r99.json")
+    with open(doctored, "w") as f:
+        json.dump({"n": 99, "rc": 0,
+                   "parsed": _bench(fast_path_placements_per_sec=40000.0)},
+                  f)
+    rc = perfgate_main([doctored, "--pins", pins_path])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "fast_path_placements_per_sec" in out and "PG002" in out
+    assert "-20.0%" in out
+
+    clean = str(tmp_path / "BENCH_r100.json")
+    with open(clean, "w") as f:
+        json.dump(_bench(), f)
+    assert perfgate_main([clean, "--pins", pins_path]) == 0
+    # missing pins file → PG000 failure, not a crash
+    rc = perfgate_main([clean, "--pins", str(tmp_path / "nope.json")])
+    assert rc == 1 and "PG000" in capsys.readouterr().out
+
+
+def test_perfgate_catches_the_real_r05_regression(tmp_path):
+    """The committed r04→r05 artifacts contain a real −13% fast_path drop
+    (measurement noise, per BASELINE.md round 5) — pinning r04 must make
+    the gate fail r05 naming that metric."""
+    r04 = os.path.join(ROOT, "BENCH_r04.json")
+    r05 = os.path.join(ROOT, "BENCH_r05.json")
+    if not (os.path.exists(r04) and os.path.exists(r05)):
+        pytest.skip("committed bench artifacts not present")
+    pins = pg.make_pins(pg.load_bench(r04), r04)
+    findings, skip = pg.compare(pg.load_bench(r05), pins)
+    assert skip is None
+    hits = [f for f in findings
+            if (f.metric, f.rule) == ("fast_path_placements_per_sec",
+                                      "PG002")]
+    assert len(hits) == 1 and "-13.0%" in hits[0].message
+
+
+def test_perfgate_bench_files_numeric_sort(tmp_path):
+    for n in (2, 11, 100):
+        (tmp_path / f"BENCH_r{n:02d}.json").write_text("{}")
+    names = [os.path.basename(p) for p in pg.bench_files(str(tmp_path))]
+    assert names == ["BENCH_r02.json", "BENCH_r11.json", "BENCH_r100.json"]
+
+
+# --- CLI surfaces ------------------------------------------------------------
+
+def test_resilience_cli_dumps_metrics_and_trace(tmp_path):
+    """A fault-injected resilience sweep must emit valid Prometheus text
+    and a trace JSONL whose spans show the degradation rung-by-rung."""
+    from cluster_capacity_tpu.cli.resilience import run
+
+    snap = os.path.join(ROOT, "examples", "cluster-snapshot.yaml")
+    if not os.path.exists(snap):
+        pytest.skip("example snapshot not present")
+    mpath = str(tmp_path / "metrics.prom")
+    tpath = str(tmp_path / "trace.jsonl")
+    rc = run(["--snapshot", snap, "--nodes", "-o", "json",
+              "--inject-fault", "parallel.solve_group:oom:1:99",
+              "--metrics-dump", mpath, "--trace-out", tpath])
+    assert rc == 0
+    text = open(mpath).read()
+    assert "cc_guard_runs_total" in text
+    assert "cc_faults_injected_total" in text
+    assert 'cc_resilience_scenarios{state="completed"}' in text
+    events = [json.loads(ln) for ln in open(tpath)]
+    oom = [ev for ev in events
+           if ev["args"].get("site") == "parallel.solve_group"
+           and ev["args"]["outcome"] == "DeviceOOM"]
+    assert oom, "no failed batched-group span in the trace"
+    served = [ev for ev in events
+              if ev["args"].get("outcome") == "ok"
+              and ev["args"].get("rung")]
+    assert served, "no serving rung span in the trace"

@@ -19,6 +19,9 @@ HOT_DIR_PREFIXES = (
     "cluster_capacity_tpu/ops/",
     "cluster_capacity_tpu/resilience/",
     "cluster_capacity_tpu/runtime/",
+    # telemetry taps run inside the dispatch choke point: a host sync here
+    # would stall every guarded call, so obs/ is policed as hot
+    "cluster_capacity_tpu/obs/",
 )
 
 # Function qualnames allowed to synchronize with the device.  A sync call

@@ -1,0 +1,23 @@
+"""perfgate: bench-throughput regression gate.
+
+The committed ``pins.json`` pins a throughput floor for every gated metric
+in the latest BENCH_r*.json round (every ``*_per_sec`` key plus the
+headline ``metric``/``value`` pair).  ``python -m tools.perfgate`` compares
+a bench artifact against the pins with a tolerance band — the perf
+counterpart of irgate's static cost budgets — and a failure names the
+metric, the floor, the measured value, the percentage delta, and the
+scenario's compile-vs-steady phase breakdown, so CI reads like a diff.
+
+Compile time is excluded by construction: bench.py measures every pps
+AFTER its warmup pass, and records the warmup/steady split (plus the
+backend-recompile counter from cluster_capacity_tpu/obs) under
+``phases`` so a recompile storm is attributable at a glance.
+
+``--update-pins`` regenerates the floors from a bench artifact; the diff
+is the reviewed record of a deliberate perf change, exactly like
+``irgate --update-budgets``.
+"""
+
+from .gate import (DEFAULT_PINS, PerfFinding, bench_files, compare,  # noqa: F401
+                   gated_metrics, load_bench, load_pins, make_pins,
+                   save_pins)

@@ -1,0 +1,86 @@
+"""perfgate CLI: `python -m tools.perfgate [BENCH_rNN.json]`.
+
+Default run = compare the given bench artifact (default: the latest
+committed BENCH_r*.json, numerically sorted) against the committed
+throughput floors in tools/perfgate/pins.json.  Exit 0 = clean or
+skipped (platform change / no artifacts yet), 1 = findings.
+
+Flags:
+
+  --pins PATH      compare against an alternate pins file
+  --update-pins    rewrite the pins file from this artifact's metrics
+  --tolerance PCT  tolerance band written by --update-pins (default 10)
+  --json           print the machine-readable report to stdout
+  --json-out FILE  write the same report to FILE (tools/ci.py runs steps
+                   without a shell, so `>` redirection is unavailable)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from . import gate
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m tools.perfgate")
+    ap.add_argument("bench", nargs="?", metavar="BENCH_JSON",
+                    help="bench artifact to gate (default: latest "
+                         "committed BENCH_r*.json)")
+    ap.add_argument("--pins", metavar="PATH", default=gate.DEFAULT_PINS)
+    ap.add_argument("--update-pins", action="store_true")
+    ap.add_argument("--tolerance", type=float,
+                    default=gate.DEFAULT_TOLERANCE_PCT, metavar="PCT")
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--json-out", metavar="FILE")
+    args = ap.parse_args(argv)
+
+    bench_path = args.bench
+    if not bench_path:
+        files = gate.bench_files()
+        if not files:
+            print("perfgate: skipped (no BENCH_r*.json artifacts yet)")
+            return 0
+        bench_path = files[-1]
+    bench = gate.load_bench(bench_path)
+
+    if args.update_pins:
+        doc = gate.make_pins(bench, bench_path, tolerance_pct=args.tolerance)
+        gate.save_pins(doc, args.pins)
+        print(f"perfgate: pinned {len(doc['metrics'])} metric floor(s) "
+              f"from {os.path.basename(bench_path)} to "
+              f"{os.path.relpath(args.pins, gate.ROOT)}")
+        return 0
+
+    findings, skip = gate.compare(bench, gate.load_pins(args.pins))
+    doc = {
+        "perfgate": 1,
+        "bench": os.path.basename(bench_path),
+        "clean": not findings,
+        "skipped": skip,
+        "findings": [{"metric": f.metric, "rule": f.rule,
+                      "message": f.message} for f in findings],
+    }
+    if args.json_out:
+        with open(args.json_out, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh, indent=2)
+            fh.write("\n")
+    if args.json:
+        print(json.dumps(doc, indent=2))
+    else:
+        if skip:
+            print(f"perfgate: skipped — {skip}")
+        for f in findings:
+            print(f.render())
+        if not skip:
+            n = len(gate.gated_metrics(bench))
+            print(f"perfgate: {os.path.basename(bench_path)}: {n} gated "
+                  f"metric(s), {len(findings)} finding(s)")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

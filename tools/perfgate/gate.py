@@ -1,0 +1,198 @@
+"""Throughput pins: load, compare, and update ``pins.json``.
+
+Mirrors tools/irgate/budgets.py: percentages live in the committed file
+(loosening a tolerance is itself a reviewed change), ``compare`` turns a
+fresh bench artifact against the pins into findings with readable deltas,
+and regeneration is an explicit ``--update-pins`` run whose diff shows
+exactly which floors moved.
+
+Rules:
+
+  PG000  no committed pins.json
+  PG001  gated metric has no pin (new metric — update pins and review)
+  PG002  regression: metric fell below floor*(1 - tolerance)
+  PG003  pinned metric missing from the bench artifact (stale pin or a
+         scenario that stopped producing its key)
+
+A platform change (cpu pins vs a tpu run, or vice versa) is a *skip*, not
+a failure: floors are platform-specific by nature, exactly like the bench
+trend check.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(os.path.dirname(_HERE))
+DEFAULT_PINS = os.path.join(_HERE, "pins.json")
+DEFAULT_TOLERANCE_PCT = 10.0
+
+_HEADER = (
+    "Bench throughput floors pinned by tools/perfgate (PR 6).  Regenerate "
+    "with `python -m tools.perfgate --update-pins [BENCH_rNN.json]` and "
+    "review the diff; tolerance_pct is part of the reviewed contract.  "
+    "Floors gate steady-state throughput only — bench.py measures every "
+    "pps after its warmup pass, so compile time never enters a gated "
+    "metric (the phases block in the artifact carries the split).")
+
+# metric prefix -> bench scenario name (the key into the artifact's
+# "phases" block, for the compile-vs-steady breakdown in failure messages)
+_SCENARIO_PREFIXES = (
+    ("fast_path_", "fast"),
+    ("scan_engine_ipa_", "ipa"),
+    ("scan_engine_", "scan"),
+    ("sweep_", "sweep"),
+    ("c5_", "c5"),
+    ("interleave_", "interleave"),
+    ("resilience_", "resilience"),
+)
+
+
+@dataclass(frozen=True)
+class PerfFinding:
+    """One throughput-gate violation."""
+
+    metric: str
+    rule: str
+    message: str
+
+    def render(self) -> str:
+        return f"perfgate: {self.metric} {self.rule}: {self.message}"
+
+
+def bench_files(root: str = ROOT) -> List[str]:
+    """Committed BENCH_r*.json artifacts, numerically sorted by round
+    (lexicographic order would rank r100 below r11)."""
+    return sorted(
+        glob.glob(os.path.join(root, "BENCH_r*.json")),
+        key=lambda p: (int(m.group(1)) if (m := re.search(
+            r"BENCH_r(\d+)\.json$", p)) else -1, p))
+
+
+def load_bench(path: str) -> Dict[str, Any]:
+    """Load a bench artifact, unwrapping the driver's envelope
+    ({"n", "cmd", "rc", "tail", "parsed": {...}}) when present."""
+    with open(path, "r", encoding="utf-8") as fh:
+        doc = json.load(fh)
+    return doc.get("parsed", doc)
+
+
+def gated_metrics(bench: Dict[str, Any]) -> Dict[str, float]:
+    """The throughput keys the gate covers: every ``*_per_sec`` number plus
+    the headline metric (bench["metric"] names it, bench["value"] holds
+    it).  Counts/configs (nodes, templates, limits) are deliberately not
+    gated — they describe the workload, not the speed."""
+    out: Dict[str, float] = {}
+    headline = bench.get("metric")
+    if isinstance(headline, str) and isinstance(
+            bench.get("value"), (int, float)):
+        out[headline] = float(bench["value"])
+    for k, v in bench.items():
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            continue
+        if k.endswith("_per_sec"):
+            out[k] = float(v)
+    return out
+
+
+def scenario_for(metric: str) -> str:
+    for prefix, scenario in _SCENARIO_PREFIXES:
+        if metric.startswith(prefix):
+            return scenario
+    return "scan"            # the headline metric lives in the scan child
+
+
+def _phase_note(bench: Dict[str, Any], metric: str) -> str:
+    ph = (bench.get("phases") or {}).get(scenario_for(metric))
+    if not isinstance(ph, dict) or not ph:
+        return ""
+    parts = []
+    for key, label in (("warmup_s", "warmup"), ("steady_s", "steady"),
+                       ("recompiles", "recompiles"),
+                       ("backend_compile_s", "backend_compile")):
+        if key in ph:
+            v = ph[key]
+            parts.append(f"{label} {v}s" if key.endswith("_s")
+                         else f"{label} {v}")
+    if "steady_reps_s" in ph:
+        parts.append(f"steady reps {ph['steady_reps_s']}")
+    return "; phases[" + scenario_for(metric) + "]: " + ", ".join(parts)
+
+
+def load_pins(path: str = DEFAULT_PINS) -> Optional[Dict[str, Any]]:
+    if not os.path.exists(path):
+        return None
+    with open(path, "r", encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def make_pins(bench: Dict[str, Any], source: str,
+              tolerance_pct: float = DEFAULT_TOLERANCE_PCT
+              ) -> Dict[str, Any]:
+    return {
+        "_comment": _HEADER,
+        "platform": bench.get("platform", "unknown"),
+        "source": os.path.basename(source),
+        "tolerance_pct": float(tolerance_pct),
+        "metrics": dict(sorted(gated_metrics(bench).items())),
+    }
+
+
+def save_pins(doc: Dict[str, Any], path: str = DEFAULT_PINS) -> None:
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=False)
+        fh.write("\n")
+
+
+def compare(bench: Dict[str, Any], pins: Optional[Dict[str, Any]]
+            ) -> Tuple[List[PerfFinding], Optional[str]]:
+    """Bench artifact vs committed floors → (findings, skip_reason).
+
+    skip_reason is non-None when the comparison is not meaningful
+    (platform changed): the caller warns and exits clean."""
+    if pins is None:
+        return ([PerfFinding(
+            "*", "PG000",
+            "no committed pins.json — run `python -m tools.perfgate "
+            "--update-pins` and commit the file")], None)
+    got_platform = bench.get("platform", "unknown")
+    pin_platform = pins.get("platform", "unknown")
+    if got_platform != pin_platform:
+        return ([], f"platform changed ({pin_platform} -> {got_platform}); "
+                    f"floors are platform-specific — re-pin with "
+                    f"--update-pins on the new platform")
+    tol = float(pins.get("tolerance_pct", DEFAULT_TOLERANCE_PCT))
+    pinned: Dict[str, float] = pins.get("metrics", {})
+    measured = gated_metrics(bench)
+    findings: List[PerfFinding] = []
+    for name in sorted(measured):
+        value = measured[name]
+        floor = pinned.get(name)
+        if floor is None:
+            findings.append(PerfFinding(
+                name, "PG001",
+                f"gated metric has no committed floor (measured "
+                f"{value:.2f}) — run --update-pins and review the new pin"))
+            continue
+        limit = floor * (1.0 - tol / 100.0)
+        if value < limit:
+            pct = (value / floor - 1.0) * 100.0 if floor else 0.0
+            findings.append(PerfFinding(
+                name, "PG002",
+                f"throughput regression: {floor:.2f} -> {value:.2f} "
+                f"({pct:+.1f}%, tolerance -{tol:g}%)"
+                + _phase_note(bench, name)))
+    for name in sorted(pinned):
+        if name not in measured:
+            findings.append(PerfFinding(
+                name, "PG003",
+                "pinned metric missing from the bench artifact — stale pin "
+                "or a scenario stopped producing its key; run "
+                "--update-pins if the removal was deliberate"))
+    return (findings, None)
