@@ -7,7 +7,7 @@ CXX ?= g++
 CXXFLAGS ?= -O2 -std=c++17 -fPIC -Wall
 NATIVE_LIB := cluster_capacity_tpu/models/libccsnap.so
 
-.PHONY: all build native lint test-unit test-parity test-fuzz test-dist test-integration test-e2e bench perfgate chaos clean verify-native ci
+.PHONY: all build native lint test-unit test-parity test-fuzz test-dist test-integration test-e2e bench perfgate trend chaos clean verify-native ci
 
 all: build
 
@@ -75,6 +75,13 @@ bench:
 # --update-pins` and review the diff).
 perfgate:
 	$(PY) -m tools.perfgate
+
+# Cross-round metric history: merge the committed BENCH_r*.json /
+# MULTICHIP_r*.json artifacts (and the gates' --json-out reports when
+# present) into TREND.md + TREND.json, flagging >10% throughput drops
+# between consecutive rounds.
+trend:
+	$(PY) -m tools.trend
 
 # Full CI pipeline: lint + native + default suite + fuzz slice +
 # integration + multichip dryrun, as configured in ci.yaml (the

@@ -44,7 +44,11 @@ N_NODES = int(os.environ.get("BENCH_NODES", "10000"))
 # budget could eat the whole alive window).  A live tunnel answers a tiny
 # matmul in well under a minute; anything slower is as good as dead.
 PROBE_RETRIES = int(os.environ.get("BENCH_PROBE_RETRIES", "1"))
-PROBE_TIMEOUT = int(os.environ.get("BENCH_PROBE_TIMEOUT", "60"))
+# CC_PROBE_TIMEOUT is the documented knob (BENCH_PROBE_TIMEOUT kept as the
+# legacy spelling): seconds before the single probe attempt is declared dead
+# and the bench fails over to CPU.
+PROBE_TIMEOUT = int(os.environ.get(
+    "CC_PROBE_TIMEOUT", os.environ.get("BENCH_PROBE_TIMEOUT", "60")))
 BASELINE_PLACEMENTS_PER_SEC = 100.0
 # Persistent compile cache shared with tpu_capture.py: any compile a live
 # window ever paid is reused here, so the bench spends its window measuring.
@@ -81,11 +85,17 @@ def _cache_env(env: dict) -> dict:
     return env
 
 
-def _probe_accelerator() -> bool:
+def _probe_accelerator() -> tuple:
     """Initialize the default JAX backend in THROWAWAY subprocesses first: a
     dead TPU tunnel hangs backend init forever, and a hang inside this
-    process could not be recovered.  Falls back to CPU so the one JSON line
-    always prints."""
+    process could not be recovered.  Falls back to CPU (after the single
+    bounded attempt, by default) so the one JSON line always prints.
+
+    Returns (alive, outcome): outcome is the machine-readable probe verdict
+    ("ok", "timeout:<secs>s", or "rc:<returncode>") recorded in the BENCH
+    artifact so a trend reader can tell a CPU fallback from a live window.
+    """
+    outcome = "no-attempts"
     for attempt in range(PROBE_RETRIES):
         try:
             r = subprocess.run(
@@ -96,16 +106,18 @@ def _probe_accelerator() -> bool:
                 timeout=PROBE_TIMEOUT, capture_output=True,
                 env=_cache_env(dict(os.environ)))
             if r.returncode == 0:
-                return True
+                return True, "ok"
+            outcome = f"rc:{r.returncode}"
             sys.stderr.write(
                 f"bench: probe attempt {attempt + 1} failed rc={r.returncode}\n")
         except subprocess.TimeoutExpired:
+            outcome = f"timeout:{PROBE_TIMEOUT}s"
             sys.stderr.write(
                 f"bench: probe attempt {attempt + 1} timed out "
                 f"({PROBE_TIMEOUT}s)\n")
         if attempt + 1 < PROBE_RETRIES:
             time.sleep(10)
-    return False
+    return False, outcome
 
 
 def _make_nodes(n_nodes=None, n_zones=16, cpus=(16000, 32000, 64000),
@@ -648,9 +660,10 @@ def main() -> None:
         print(json.dumps(out))
         return
 
-    accel = _probe_accelerator()
+    accel, probe_outcome = _probe_accelerator()
     if not accel:
-        sys.stderr.write("bench: accelerator probe failed; falling back to CPU\n")
+        sys.stderr.write("bench: accelerator probe failed "
+                         f"({probe_outcome}); falling back to CPU\n")
     timeout = int(os.environ.get("BENCH_SCENARIO_TIMEOUT", "480"))
 
     fp = _run_scenario("fast", accel, timeout)
@@ -680,6 +693,7 @@ def main() -> None:
         "unit": "placements/s",
         "vs_baseline": round(sc_pps / BASELINE_PLACEMENTS_PER_SEC, 2),
         "platform": platform,
+        "probe_outcome": probe_outcome,
         "scan_engine_fused_kernel": bool((sc or {}).get("fused", False)),
     }
     if ipa:

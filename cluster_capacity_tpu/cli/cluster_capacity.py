@@ -66,6 +66,12 @@ def build_parser(prog: str = "cluster-capacity") -> argparse.ArgumentParser:
                         "reference scheduler's zone-round-robin iteration.")
     p.add_argument("--parity", action="store_true",
                    help="Bit-exact kube-scheduler score arithmetic (float64).")
+    p.add_argument("--explain", action="store_true",
+                   help="Compute placement attribution on device during the "
+                        "solve: per-node why-not elimination reasons, "
+                        "per-placement why-here plugin score contributions, "
+                        "and the bottleneck analysis.  Surfaces in the "
+                        "report's explain section (verbose/json/yaml).")
     p.add_argument("--trace", action="store_true",
                    help="Print phase trace spans (snapshotting / scan) to "
                         "stderr, mirroring the reference's utiltrace spans.")
@@ -260,7 +266,8 @@ def run(argv: Optional[List[str]] = None, prog: str = "cluster-capacity") -> int
     def one_run():
         if len(pods) == 1:
             cc = ClusterCapacity(pods[0], max_limit=args.max_limit,
-                                 profile=profile, exclude_nodes=exclude)
+                                 profile=profile, exclude_nodes=exclude,
+                                 explain=args.explain)
             snap, raw_objs, snap_opts = current_snapshot()
             if snap is not None:
                 cc.set_snapshot(snap, **snap_opts)
@@ -294,13 +301,17 @@ def run(argv: Optional[List[str]] = None, prog: str = "cluster-capacity") -> int
         t0 = time.perf_counter()
         with default_tracer.span(SPAN_SOLVE), default_tracer.profile():
             if args.interleave:
+                # interleaved shared-state queues don't carry attribution —
+                # the race through one mutable cluster state has no
+                # per-template elimination story to attribute
                 from ..parallel.interleave import sweep_interleaved_auto
                 results = sweep_interleaved_auto(snapshot, pods,
                                                  profile=profile,
                                                  max_total=args.max_limit)
             else:
                 results = sweep(snapshot, pods, profile=profile,
-                                max_limit=args.max_limit)
+                                max_limit=args.max_limit,
+                                explain=args.explain)
         reg = metrics_mod.default_registry
         for r in results:
             reg.inc(metrics_mod.SCHEDULE_ATTEMPTS, amount=r.placed_count,

@@ -13,6 +13,7 @@ import sys
 from typing import List, Optional
 
 from . import cluster_capacity as cc_cli
+from . import explain as explain_cli
 from . import genpod as genpod_cli
 from . import resilience as resilience_cli
 
@@ -20,6 +21,7 @@ _COMMANDS = {
     "cluster-capacity": cc_cli.run,
     "genpod": genpod_cli.run,
     "resilience": resilience_cli.run,
+    "explain": explain_cli.run,
 }
 
 
@@ -39,7 +41,9 @@ def run(argv: Optional[List[str]] = None) -> int:
     print(f"usage: {prog} <command> [flags]\n\ncommands:\n"
           "  cluster-capacity   estimate schedulable instances of a pod\n"
           "  genpod             generate a pod spec from namespace limits\n"
-          "  resilience         N-k failure sweeps with drain re-scheduling\n",
+          "  resilience         N-k failure sweeps with drain re-scheduling\n"
+          "  explain            why-not / why-here / bottleneck attribution "
+          "for one solve\n",
           file=sys.stderr)
     return 0 if argv and argv[0] in ("-h", "--help") else 1
 

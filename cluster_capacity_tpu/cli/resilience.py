@@ -70,6 +70,11 @@ def build_parser(prog: str = "resilience") -> argparse.ArgumentParser:
     p.add_argument("--parity", action="store_true",
                    help="Bit-exact kube-scheduler score arithmetic "
                         "(float64).")
+    p.add_argument("--explain", action="store_true",
+                   help="Annotate every scenario with the degraded "
+                        "cluster's bottleneck analysis (binding resource "
+                        "dimension, remaining-capacity delta vs the intact "
+                        "baseline).")
     p.add_argument("--no-dedup", dest="no_dedup", action="store_true",
                    help="Solve every scenario separately instead of "
                         "collapsing symmetric single-node failures.")
@@ -194,7 +199,8 @@ def run(argv: Optional[List[str]] = None, prog: str = "resilience") -> int:
     try:
         report = analyze(snapshot, scenarios, probe, profile=profile,
                          max_limit=args.max_limit, dedup=not args.no_dedup,
-                         journal=args.journal or None, resume=args.resume)
+                         journal=args.journal or None, resume=args.resume,
+                         explain=args.explain)
     except CheckpointCorruption as e:
         print(f"Error: {e}", file=sys.stderr)
         return 1

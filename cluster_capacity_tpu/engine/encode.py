@@ -45,6 +45,14 @@ CODE_DRA = 12
 # before every real filter so a dead node always diagnoses as dead, not as
 # whatever plugin would also have rejected it
 CODE_NODE_FAILED = 13
+# explainability (explain/): the device-side reason stamp chain needs a code
+# for every eliminator diagnose() can attribute, including the channels that
+# historically bypassed static_code — the static volume_mask (per-node detail
+# stays in volume_reasons), the clone self disk conflict, and the RWOP
+# cluster-wide conflict.  DRA colocation reuses CODE_DRA (same reason string).
+CODE_VOLUME = 14
+CODE_VOLUME_SELF = 15
+CODE_RWOP = 16
 
 REASON_NODE_FAILED = "node(s) were simulated as failed"
 
@@ -63,6 +71,13 @@ STATIC_REASONS = {
 
 from ..ops.dynamic_resources import REASON_CANNOT_ALLOCATE as _DRA_REASON
 STATIC_REASONS[CODE_DRA] = _DRA_REASON
+
+from ..ops.volumes import REASON_DISK_CONFLICT as _DISK_REASON
+from ..ops.volumes import REASON_RWOP_CONFLICT as _RWOP_REASON
+STATIC_REASONS[CODE_VOLUME_SELF] = _DISK_REASON
+STATIC_REASONS[CODE_RWOP] = _RWOP_REASON
+# CODE_VOLUME and CODE_TAINT intentionally have no entry here: their reason
+# strings are per-node (volume_reasons / taint_reasons lists).
 
 # PreEnqueue gate wording (kubelet's condition message; single source for
 # the engine, oracle, and interleaved sweep)

@@ -158,10 +158,16 @@ def _per_node_caps(pb: enc.EncodedProblem) -> np.ndarray:
     return caps.astype(np.int64)
 
 
-def solve_fast(pb: enc.EncodedProblem, max_limit: int = 0
-               ) -> Optional[sim.SolveResult]:
+def solve_fast(pb: enc.EncodedProblem, max_limit: int = 0,
+               explain: bool = False) -> Optional[sim.SolveResult]:
     """Returns a SolveResult identical to sim.solve(), or None when the
-    configuration is outside the fast path (caller falls back to the scan)."""
+    configuration is outside the fast path (caller falls back to the scan).
+
+    With `explain`, the per-plugin components of the score matrix are kept
+    and gathered (on device) at the chosen (node, k) pairs to produce the
+    why-here attribution, and the reconstructed terminal carry feeds the
+    why-not reason codes — both bit-matching what the scan engine's explain
+    path computes step by step (tests/test_explain.py parity)."""
     import jax.numpy as jnp
 
     if not eligible(pb):
@@ -194,6 +200,11 @@ def solve_fast(pb: enc.EncodedProblem, max_limit: int = 0
     profile = pb.profile
 
     total = jnp.zeros((n, k_max), dtype=dt)
+    # why-here attribution: per-plugin components of `total`, kept only when
+    # explaining ([n,k_max] matrices, [n] vectors, or python scalars for the
+    # folded-constant plugins).  Gathering these at the chosen flat indices
+    # reproduces the scan step's per-plugin terms exactly.
+    comp = {} if explain else None
 
     w = profile.score_weight("NodeResourcesFit")
     if w:
@@ -228,6 +239,8 @@ def solve_fast(pb: enc.EncodedProblem, max_limit: int = 0
             s = least_allocated_score(a3.reshape(n * k_max, -1),
                                       req.reshape(n * k_max, -1),
                                       consts["fit_w"]).reshape(n, k_max)
+        if comp is not None:
+            comp["NodeResourcesFit"] = w * s
         total = total + w * s
 
     w = profile.score_weight("NodeResourcesBalancedAllocation")
@@ -242,6 +255,8 @@ def solve_fast(pb: enc.EncodedProblem, max_limit: int = 0
         s = balanced_allocation_score(
             jnp.broadcast_to(alloc[:, None, :], req.shape).reshape(n * k_max, -1),
             req.reshape(n * k_max, -1)).reshape(n, k_max)
+        if comp is not None:
+            comp["NodeResourcesBalancedAllocation"] = w * s
         total = total + w * s
 
     w = profile.score_weight("TaintToleration")
@@ -249,14 +264,21 @@ def solve_fast(pb: enc.EncodedProblem, max_limit: int = 0
         # reverse-normalized uniform raw: r>0 -> 100-floor(100r/r)=0 for
         # every feasible node; r==0 -> the max==0 branch scores 100
         r = _uniform_on_eligible(pb, pb.taint_raw)
+        if comp is not None:
+            comp["TaintToleration"] = (100.0 if not r else 0.0) * w
         total = total + (100.0 if not r else 0.0) * w
     w = profile.score_weight("NodeAffinity")
     if w and pb.node_affinity_active:
         # forward-normalized uniform raw: r>0 -> floor(100r/r)=100;
         # r==0 -> max==0 leaves the raw 0s untouched
         r = _uniform_on_eligible(pb, pb.node_affinity_raw)
+        if comp is not None:
+            comp["NodeAffinity"] = (100.0 if r else 0.0) * w
         total = total + (100.0 if r else 0.0) * w
     if profile.score_weight("ImageLocality"):
+        if comp is not None:
+            comp["ImageLocality"] = consts["il_score"] * \
+                profile.score_weight("ImageLocality")
         total = total + consts["il_score"][:, None] * \
             profile.score_weight("ImageLocality")
 
@@ -281,12 +303,34 @@ def solve_fast(pb: enc.EncodedProblem, max_limit: int = 0
     placements = np.asarray(chosen_nodes).astype(np.int64).tolist()
     placed = len(placements)
 
+    # Reconstruct the final carry once: the exhausted branch diagnoses from
+    # it and the explain path computes terminal why-not codes from it.
+    counts = np.bincount(placements, minlength=n) if placements else \
+        np.zeros(n, dtype=np.int64)
+    carry = None
+    if explain or placed >= total_cap:
+        final_requested = pb.init_requested + np.outer(counts, pb.req_vec)
+        final_nonzero = pb.init_nonzero + np.outer(counts, pb.req_nonzero)
+        carry = sim._init_carry(pb, consts, pb.profile.seed)
+        carry = carry._replace(
+            requested=jnp.asarray(final_requested, dtype=dt),
+            nonzero=jnp.asarray(final_nonzero, dtype=dt),
+            placed=jnp.asarray(counts, dtype=jnp.int32),
+            placed_count=jnp.asarray(placed, dtype=jnp.int32),
+            stopped=jnp.asarray(True))
+
+    expl_obj = None
+    if explain:
+        expl_obj = _explain_fast(pb, cfg, consts, carry, comp, order,
+                                 chosen_nodes, caps, counts, placements,
+                                 k_max, dt)
+
     if max_limit and placed >= max_limit:
         return sim.SolveResult(
             placements=placements, placed_count=placed,
             fail_type=sim.FAIL_LIMIT_REACHED,
             fail_message=f"Maximum number of pods simulated: {max_limit}",
-            node_names=pb.snapshot.node_names)
+            node_names=pb.snapshot.node_names, explain=expl_obj)
     if placed < total_cap:
         # the _DEFAULT_UNLIMITED_CAP clamp stopped us (scan parity message)
         return sim.SolveResult(
@@ -295,35 +339,80 @@ def solve_fast(pb: enc.EncodedProblem, max_limit: int = 0
             fail_message=(f"Simulation step budget exhausted after "
                           f"{placed} placements; set max_limit to "
                           f"bound unlimited profiles"),
-            node_names=pb.snapshot.node_names)
+            node_names=pb.snapshot.node_names, explain=expl_obj)
 
-    # Exhausted capacity → reconstruct the final state and diagnose.
-    counts = np.bincount(placements, minlength=n) if placements else \
-        np.zeros(n, dtype=np.int64)
-    final_requested = pb.init_requested + np.outer(counts, pb.req_vec)
-    final_nonzero = pb.init_nonzero + np.outer(counts, pb.req_nonzero)
-    carry = sim._init_carry(pb, consts, pb.profile.seed)
-    carry = carry._replace(
-        requested=jnp.asarray(final_requested, dtype=dt),
-        nonzero=jnp.asarray(final_nonzero, dtype=dt),
-        placed=jnp.asarray(counts, dtype=jnp.int32),
-        placed_count=jnp.asarray(placed, dtype=jnp.int32),
-        stopped=jnp.asarray(True))
+    # Exhausted capacity → diagnose from the reconstructed final state.
     reason_counts = sim.diagnose(pb, cfg, consts, carry)
     msg = sim.format_fit_error(n, reason_counts)
     return sim.SolveResult(
         placements=placements, placed_count=placed,
         fail_type=sim.FAIL_UNSCHEDULABLE, fail_message=msg,
-        fail_counts=reason_counts, node_names=pb.snapshot.node_names)
+        fail_counts=reason_counts, node_names=pb.snapshot.node_names,
+        explain=expl_obj)
+
+
+def _explain_fast(pb, cfg, consts, carry, comp, order, chosen_nodes, caps,
+                  counts, placements, k_max, dt):
+    """Assemble the fast path's Explanation: why-here gathered on device
+    from the kept score components, why-not from the reconstructed terminal
+    carry, elimination steps from the per-node fill times (a node leaves the
+    feasible set at the step after its cap fills — there is no other
+    elimination channel in a fast-path-eligible config)."""
+    import jax.numpy as jnp
+    from ..explain import artifacts as _art
+    from ..explain import attribution as _attr
+
+    n = pb.snapshot.num_nodes
+    budget = chosen_nodes.shape[0]
+    flat_sel = order[:budget]
+    why_cols = []
+    for name in _art.PLUGINS:
+        v = comp.get(name)
+        if v is None:
+            why_cols.append(jnp.zeros((budget,), dtype=dt))
+        elif getattr(v, "ndim", 0) == 2:
+            why_cols.append(v.reshape(-1)[flat_sel])
+        elif getattr(v, "ndim", 0) == 1:
+            why_cols.append(v[chosen_nodes])
+        else:       # folded per-step constant (taint / node-affinity)
+            why_cols.append(jnp.full((budget,), v, dtype=dt))
+    why_here = np.asarray(jnp.stack(why_cols, axis=1), dtype=np.float64)
+
+    codes, insuff, toomany = _attr.final_codes_runner()(
+        cfg, consts, jnp.asarray(pb.static_code, dtype=jnp.int32), carry)
+    codes = np.asarray(codes)
+
+    # Elimination record: caps==0 nodes were never feasible (step 0); a
+    # filled node is first seen infeasible at the step AFTER its last fill.
+    elim_step = np.full(n, -1, dtype=np.int32)
+    elim_code = np.zeros(n, dtype=np.int32)
+    eliminated = codes != enc.CODE_OK
+    elim_code[eliminated] = codes[eliminated]
+    elim_step[eliminated & (caps == 0)] = 0
+    filled = eliminated & (caps > 0) & (counts >= caps)
+    if filled.any():
+        cnt = np.zeros(n, dtype=np.int64)
+        for t, node in enumerate(placements):
+            cnt[node] += 1
+            if filled[node] and cnt[node] == caps[node]:
+                elim_step[node] = t + 1
+
+    return _art.build_explanation(
+        pb, why_here=why_here, final_codes=codes,
+        elim_step=elim_step, elim_code=elim_code,
+        insufficient=np.asarray(insuff), too_many=np.asarray(toomany),
+        rung="fast_path")
 
 
 def solve_auto(pb: enc.EncodedProblem, max_limit: int = 0,
-               chunk_size: int = 1024) -> sim.SolveResult:
+               chunk_size: int = 1024, explain: bool = False
+               ) -> sim.SolveResult:
     """Fast path when exact, scan engine otherwise — identical results."""
-    result = solve_fast(pb, max_limit=max_limit)
+    result = solve_fast(pb, max_limit=max_limit, explain=explain)
     if result is not None:
         return result
-    return sim.solve(pb, max_limit=max_limit, chunk_size=chunk_size)
+    return sim.solve(pb, max_limit=max_limit, chunk_size=chunk_size,
+                     explain=explain)
 
 
 # --------------------------------------------------------------------------

@@ -293,29 +293,41 @@ def _ipa_filter(state: OracleState, i: int, pod: dict) -> Optional[str]:
 # --- Scores ----------------------------------------------------------------
 
 def _score_nodes(state: OracleState, feasible: List[int], pod: dict,
-                 profile: SchedulerProfile) -> Dict[int, int]:
+                 profile: SchedulerProfile,
+                 breakdown: Optional[dict] = None) -> Dict[int, int]:
+    """Per-node totals; with `breakdown` given, also records each plugin's
+    weighted per-node contribution ({plugin: {i: int}}) for why-here
+    attribution — the values folded into totals, unchanged."""
     snap = state.snapshot
     totals = {i: 0 for i in feasible}
+
+    def fold(name: str, vals: Dict[int, int]) -> None:
+        for i, v in vals.items():
+            totals[i] += v
+        if breakdown is not None:
+            breakdown[name] = vals
 
     w = profile.score_weight("NodeResourcesFit")
     if w:
         raw = {i: _fit_score(state, i, pod, profile) for i in feasible}
-        for i in feasible:
-            totals[i] += w * raw[i]
+        fold("NodeResourcesFit", {i: w * raw[i] for i in feasible})
 
     w = profile.score_weight("NodeResourcesBalancedAllocation")
     if w:
-        for i in feasible:
-            totals[i] += w * _balanced_score(state, i, pod, profile)
+        fold("NodeResourcesBalancedAllocation",
+             {i: w * _balanced_score(state, i, pod, profile)
+              for i in feasible})
 
     w = profile.score_weight("TaintToleration")
     if w:
         raw = {i: lbl.count_intolerable_prefer_no_schedule(
             snap.node_taints(i), ps.pod_tolerations(pod)) for i in feasible}
         mx = max(raw.values(), default=0)
+        vals = {}
         for i in feasible:
             s = 100 * raw[i] // mx if mx > 0 else 0
-            totals[i] += w * (100 - s if mx > 0 else 100)
+            vals[i] = w * (100 - s if mx > 0 else 100)
+        fold("TaintToleration", vals)
 
     w = profile.score_weight("NodeAffinity")
     aff = ((pod.get("spec") or {}).get("affinity") or {}).get("nodeAffinity") or {}
@@ -324,30 +336,28 @@ def _score_nodes(state: OracleState, feasible: List[int], pod: dict,
             pod.get("spec") or {}, snap.node_labels(i), snap.node_names[i])
             for i in feasible}
         mx = max(raw.values(), default=0)
-        for i in feasible:
-            totals[i] += w * (100 * raw[i] // mx if mx > 0 else raw[i])
+        fold("NodeAffinity",
+             {i: w * (100 * raw[i] // mx if mx > 0 else raw[i])
+              for i in feasible})
 
     w = profile.score_weight("ImageLocality")
     if w:
         from ..ops.image_locality import static_score
         raw = static_score(snap, pod)
-        for i in feasible:
-            totals[i] += w * int(raw[i])
+        fold("ImageLocality", {i: w * int(raw[i]) for i in feasible})
 
     w = profile.score_weight("PodTopologySpread")
     if w:
         soft, require_all = _soft_constraints(state, pod)
         if soft:
             raw = _spread_scores(state, feasible, pod, soft, require_all)
-            for i in feasible:
-                totals[i] += w * raw[i]
+            fold("PodTopologySpread", {i: w * raw[i] for i in feasible})
 
     w = profile.score_weight("InterPodAffinity")
     if w:
         raw = _ipa_scores(state, feasible, pod)
         if raw is not None:
-            for i in feasible:
-                totals[i] += w * raw[i]
+            fold("InterPodAffinity", {i: w * raw[i] for i in feasible})
     return totals
 
 
@@ -708,8 +718,16 @@ def simulate_with_preemption(snapshot: ClusterSnapshot, template: dict,
 
 def simulate(snapshot: ClusterSnapshot, template: dict,
              profile: Optional[SchedulerProfile] = None,
-             max_limit: int = 0):
-    """Sequential greedy simulation; returns (placements, fail_counts)."""
+             max_limit: int = 0, explain_out: Optional[dict] = None):
+    """Sequential greedy simulation; returns (placements, fail_counts).
+
+    With `explain_out` (a dict the caller owns), the oracle also records
+    attribution: "why_here" — per placement the per-plugin weighted score
+    contributions of the chosen node, in explain/artifacts.PLUGINS order;
+    "elim_step" / "elim_reason" — per node the step index at which it first
+    left the feasible set (-1 = never) and its first-fail reason string.
+    This is the reference recomputation the device rungs' attribution is
+    parity-tested against."""
     from ..ops import volumes as vol_ops
 
     profile = profile or SchedulerProfile.parity()
@@ -717,6 +735,13 @@ def simulate(snapshot: ClusterSnapshot, template: dict,
     placements: List[int] = []
     step = 0
     n = snapshot.num_nodes
+
+    if explain_out is not None:
+        from ..explain.artifacts import PLUGINS
+        explain_out.setdefault("plugins", list(PLUGINS))
+        explain_out.setdefault("why_here", [])
+        explain_out.setdefault("elim_step", [-1] * n)
+        explain_out.setdefault("elim_reason", [None] * n)
 
     if (template.get("spec") or {}).get("schedulingGates"):
         from .encode import REASON_SCHEDULING_GATED
@@ -752,6 +777,13 @@ def simulate(snapshot: ClusterSnapshot, template: dict,
         if max_limit and len(placements) >= max_limit:
             return placements, {}
         feasible = [i for i in range(n) if node_reason(i) is None]
+        if explain_out is not None:
+            feas_set = set(feasible)
+            es = explain_out["elim_step"]
+            for i in range(n):
+                if es[i] < 0 and i not in feas_set:
+                    es[i] = step
+                    explain_out["elim_reason"][i] = node_reason(i)
         if not feasible:
             reasons: Dict[str, int] = {}
             for i in range(n):
@@ -764,8 +796,13 @@ def simulate(snapshot: ClusterSnapshot, template: dict,
             return placements, reasons
         scorable, next_start = sample_window(feasible, n, sample_k,
                                              next_start)
-        totals = _score_nodes(state, scorable, template, profile)
+        bd = {} if explain_out is not None else None
+        totals = _score_nodes(state, scorable, template, profile,
+                              breakdown=bd)
         best = max(scorable, key=lambda i: (totals[i], -i))
+        if explain_out is not None:
+            explain_out["why_here"].append(
+                [bd.get(p, {}).get(best, 0) for p in explain_out["plugins"]])
         placements.append(best)
         placed_per_node[best] += 1
         clone = ps.make_clone(template, step)

@@ -189,6 +189,9 @@ class SolveResult:
     # classified fault occurred on the way (runtime/degrade.py).
     rung: str = ""
     degraded: bool = False
+    # Attribution artifact (explain/artifacts.Explanation) when the solve ran
+    # with explain=True; None otherwise.
+    explain: Optional[object] = None
 
     @property
     def per_node_counts(self) -> Dict[str, int]:
@@ -479,11 +482,15 @@ def _feasibility(cfg: StaticConfig, consts, carry: Carry, eanti_dyn=None,
     return feasible, parts
 
 
-def _scores(cfg: StaticConfig, consts, carry: Carry, feasible):
+def _score_terms(cfg: StaticConfig, consts, carry: Carry, feasible):
+    """Ordered (plugin name, already-weighted [N] term) pairs for the active
+    score plugins.  _scores sums them in order, so the expression tree — and
+    with it the compiled program — is identical to the historical inline
+    accumulation; explain/ reads the same terms per placement without a
+    second scoring pass."""
     import jax.numpy as jnp
     dt = _dt(cfg)
-    n = consts["static_mask"].shape[0]
-    total = jnp.zeros(n, dtype=dt)
+    terms = []
 
     w = _weight(cfg, "NodeResourcesFit")
     if w:
@@ -503,7 +510,7 @@ def _scores(cfg: StaticConfig, consts, carry: Carry, feasible):
                 alloc, req, consts["fit_w"], cfg.fit_shape[0], cfg.fit_shape[1])
         else:
             s = fit_ops.least_allocated_score(alloc, req, consts["fit_w"])
-        total = total + w * jnp.where(feasible, s, 0.0)
+        terms.append(("NodeResourcesFit", w * jnp.where(feasible, s, 0.0)))
 
     w = _weight(cfg, "NodeResourcesBalancedAllocation")
     if w:
@@ -512,21 +519,25 @@ def _scores(cfg: StaticConfig, consts, carry: Carry, feasible):
         req = jnp.stack([carry.requested[:, j] for j in cfg.bal_idx],
                         axis=1) + consts["bal_req"][None, :]
         s = fit_ops.balanced_allocation_score(alloc, req)
-        total = total + w * jnp.where(feasible, s, 0.0)
+        terms.append(("NodeResourcesBalancedAllocation",
+                      w * jnp.where(feasible, s, 0.0)))
 
     w = _weight(cfg, "TaintToleration")
     if w:
-        total = total + w * _default_normalize(consts["taint_raw"], feasible,
-                                               reverse=True)
+        terms.append(("TaintToleration",
+                      w * _default_normalize(consts["taint_raw"], feasible,
+                                             reverse=True)))
 
     w = _weight(cfg, "NodeAffinity")
     if w and cfg.na_active:
-        total = total + w * _default_normalize(consts["na_raw"], feasible,
-                                               reverse=False)
+        terms.append(("NodeAffinity",
+                      w * _default_normalize(consts["na_raw"], feasible,
+                                             reverse=False)))
 
     w = _weight(cfg, "ImageLocality")
     if w:
-        total = total + w * jnp.where(feasible, consts["il_score"], 0.0)
+        terms.append(("ImageLocality",
+                      w * jnp.where(feasible, consts["il_score"], 0.0)))
 
     w = _weight(cfg, "PodTopologySpread")
     if w and cfg.spread_soft_n > 0:
@@ -537,14 +548,25 @@ def _scores(cfg: StaticConfig, consts, carry: Carry, feasible):
             carry.ss_cnt, hostname_cnt, consts["ss_dom"], consts["ss_host"],
             consts["ss_skew"], consts["ss_onehot"], consts["ss_ignored"],
             feasible, use_onehot=cfg.ss_onehot_ok)
-        total = total + w * spread_ops.soft_normalize(raw, scored)
+        terms.append(("PodTopologySpread",
+                      w * spread_ops.soft_normalize(raw, scored)))
 
     w = _weight(cfg, "InterPodAffinity")
     if w and cfg.ipa_score_active:
         raw = ipa_ops.pref_score(carry.pref_cnt, consts["ipa_dom"],
                                  consts["ipa_static_pref"], cfg.ipa_num_pref)
-        total = total + w * ipa_ops.normalize(raw, feasible, True)
+        terms.append(("InterPodAffinity",
+                      w * ipa_ops.normalize(raw, feasible, True)))
 
+    return terms
+
+
+def _scores(cfg: StaticConfig, consts, carry: Carry, feasible):
+    import jax.numpy as jnp
+    n = consts["static_mask"].shape[0]
+    total = jnp.zeros(n, dtype=_dt(cfg))
+    for _name, term in _score_terms(cfg, consts, carry, feasible):
+        total = total + term
     return total
 
 
@@ -707,7 +729,8 @@ def _ensure_x64(profile):
 
 
 def solve(pb: enc.EncodedProblem, max_limit: int = 0,
-          chunk_size: int = 1024, mesh=None) -> SolveResult:
+          chunk_size: int = 1024, mesh=None, explain: bool = False
+          ) -> SolveResult:
     """Run the greedy placement loop to completion.
 
     The scan runs in fixed-size chunks of a jitted `lax.scan`; chunks repeat
@@ -715,7 +738,17 @@ def solve(pb: enc.EncodedProblem, max_limit: int = 0,
 
     With `mesh` given, consts and carry shard over it (node axis across
     devices, multi-host included) and XLA inserts the ICI/DCN collectives;
-    placements are identical to the unsharded solve."""
+    placements are identical to the unsharded solve.
+
+    With `explain`, the solve runs the explain scan runner instead of the
+    canonical one (same placements — the explain step replays _step
+    op-for-op) and attaches an explain/artifacts.Explanation to the result:
+    why-here score attribution per placement, the why-not elimination tensor
+    per node, and the bottleneck table.  Attribution rides the scan as extra
+    outputs read back at the same per-chunk sync the solve already pays; the
+    fused Pallas drive is skipped (it packs the carry in kernel-private
+    layout and exposes no per-step score terms).  `explain` is ignored on
+    mesh-sharded solves."""
     import jax
     import numpy as np
 
@@ -729,12 +762,18 @@ def solve(pb: enc.EncodedProblem, max_limit: int = 0,
         # PreEnqueue/PreFilter pod-level rejection: the FitError message is
         # "0/N nodes are available: <PreFilterMsg>." (types.go:788-793).
         n = pb.snapshot.num_nodes
+        expl_obj = None
+        if explain:
+            from ..explain import artifacts as _art
+            expl_obj = _art.build_explanation(
+                pb, histogram={pb.pod_level_reason: n}, rung="scan")
         return SolveResult(
             placements=[], placed_count=0,
             fail_type=pb.pod_level_fail_type,
             fail_message=f"0/{n} nodes are available: {pb.pod_level_reason}.",
             fail_counts={pb.pod_level_reason: n},
-            node_names=pb.snapshot.node_names)
+            node_names=pb.snapshot.node_names,
+            explain=expl_obj)
 
     _ensure_x64(pb.profile)
     cfg = static_config(pb)
@@ -763,8 +802,12 @@ def solve(pb: enc.EncodedProblem, max_limit: int = 0,
     # stays packed on device — only the chosen indices and the stop flag
     # cross to the host.
     from . import fused
+    explain = explain and mesh is None
     fused_runner = None
-    if mesh is None:    # the Pallas kernel is single-device; meshes use XLA
+    if mesh is None and not explain:
+        # the Pallas kernel is single-device; meshes use XLA.  Explain also
+        # takes the XLA scan: the fused kernel's packed carry exposes no
+        # per-step score terms to attribute.
         fused_runner = fused.make_runner(
             cfg, pb, consts, verify_against=(consts, carry, min(48, budget)))
 
@@ -859,23 +902,59 @@ def solve(pb: enc.EncodedProblem, max_limit: int = 0,
             if last_good is not None:
                 carry = fused_runner.unpack(last_good, carry)
             stopped = False    # unknown at the fallback point; XLA decides
-    while not stopped and len(placements) < budget:
-        carry, chosen = run_chunk(cfg, consts, carry, chunk_size)
-        stopped = bool(np.asarray(carry.stopped))
-        chosen = np.asarray(chosen)
-        placements.extend(chosen[chosen >= 0].tolist())
-        if stopped:
-            break
+    expl_state = None
+    why_rows: List[np.ndarray] = []
+    if explain:
+        import jax.numpy as jnp
+        from ..explain import attribution as _attr
+        run_explain = _attr.chunk_runner()
+        static_code_dev = jnp.asarray(pb.static_code, dtype=jnp.int32)
+        expl_state = _attr.init_state(carry)
+        while not stopped and len(placements) < budget:
+            expl_state, (chosen, contribs) = run_explain(
+                cfg, consts, static_code_dev, expl_state, chunk_size)
+            carry = expl_state.carry
+            stopped = bool(np.asarray(carry.stopped))
+            chosen = np.asarray(chosen)
+            keep = chosen >= 0
+            placements.extend(chosen[keep].tolist())
+            why_rows.append(np.asarray(contribs)[keep])
+    else:
+        while not stopped and len(placements) < budget:
+            carry, chosen = run_chunk(cfg, consts, carry, chunk_size)
+            stopped = bool(np.asarray(carry.stopped))
+            chosen = np.asarray(chosen)
+            placements.extend(chosen[chosen >= 0].tolist())
+            if stopped:
+                break
     placements = placements[:budget]
     placed = len(placements)
     stopped = bool(np.asarray(carry.stopped))
+
+    expl_obj = None
+    if expl_state is not None:
+        from ..explain import artifacts as _art
+        from ..explain import attribution as _attr
+        codes, insuff, toomany = _attr.final_codes_runner()(
+            cfg, consts, static_code_dev, carry)
+        why_here = (np.concatenate(why_rows)[:placed] if why_rows
+                    else np.zeros((0, len(_art.PLUGINS))))
+        expl_obj = _art.build_explanation(
+            pb, why_here=why_here,
+            final_codes=np.asarray(codes),
+            elim_step=np.asarray(expl_state.elim_step),
+            elim_code=np.asarray(expl_state.elim_code),
+            insufficient=np.asarray(insuff),
+            too_many=np.asarray(toomany),
+            rung="scan")
 
     if max_limit and placed >= max_limit:
         # postBindHook limit semantics (simulator.go:297-312).
         return SolveResult(placements=placements, placed_count=placed,
                            fail_type=FAIL_LIMIT_REACHED,
                            fail_message=f"Maximum number of pods simulated: {max_limit}",
-                           node_names=pb.snapshot.node_names)
+                           node_names=pb.snapshot.node_names,
+                           explain=expl_obj)
     if mesh is not None and jax.process_count() > 1:
         # gather the node-sharded carry to every host for diagnosis (one
         # all-gather over DCN at the very end of the solve)
@@ -886,7 +965,8 @@ def solve(pb: enc.EncodedProblem, max_limit: int = 0,
         return SolveResult(placements=placements, placed_count=placed,
                            fail_type=FAIL_UNSCHEDULABLE, fail_message=msg,
                            fail_counts=counts,
-                           node_names=pb.snapshot.node_names)
+                           node_names=pb.snapshot.node_names,
+                           explain=expl_obj)
     # Internal step budget exhausted without a user limit (only reachable when
     # the fit filter is disabled, so the hint bound is not authoritative).
     return SolveResult(placements=placements, placed_count=placed,
@@ -894,7 +974,8 @@ def solve(pb: enc.EncodedProblem, max_limit: int = 0,
                        fail_message=(f"Simulation step budget exhausted after "
                                      f"{placed} placements; set max_limit to "
                                      f"bound unlimited profiles"),
-                       node_names=pb.snapshot.node_names)
+                       node_names=pb.snapshot.node_names,
+                       explain=expl_obj)
 
 
 @functools.lru_cache(maxsize=8)

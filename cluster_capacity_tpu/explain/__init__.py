@@ -1,0 +1,35 @@
+"""Placement explainability: device-computed attribution for every solve.
+
+Three products per solve (ISSUE: why-not / why-here / bottleneck):
+
+- **why-not** — a per-node elimination record extending the static
+  `static_code` encoding into the full filter chain: every node carries the
+  reason code of its first failing plugin (diagnose() priority order) at
+  every step, computed on device inside the jitted scan (attribution.py),
+  plus the step index at which the node was first eliminated.  The terminal
+  codes expand to the same reason-string histogram diagnose() produces —
+  over ALL nodes, not just the terminal unschedulable pod.
+- **why-here** — per-plugin weighted score contributions for each placement,
+  a [placements, plugins] artifact decomposed from the engine's own score
+  terms (simulator._score_terms) and the fast path's score matrix.
+- **bottleneck** — which resource dimension binds first per node and the
+  cluster-wide marginal capacity per resource (bottleneck.py, pure host
+  numpy over the fit encodings — dispatch-free).
+
+All device→host readbacks happen inside the designated solver collect
+points (sim.solve / fast_path.solve_fast / parallel drivers), so the
+jaxlint host-sync baseline and the irgate IC001 (no host callbacks)
+contract stay clean: attribution rides the solve as extra scan outputs,
+never as a callback or a mid-loop sync.
+"""
+
+from .artifacts import PLUGINS, Explanation, build_explanation, reason_histogram
+from .bottleneck import bottleneck_analysis
+
+__all__ = [
+    "PLUGINS",
+    "Explanation",
+    "build_explanation",
+    "reason_histogram",
+    "bottleneck_analysis",
+]

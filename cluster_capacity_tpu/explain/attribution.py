@@ -1,0 +1,201 @@
+"""Device-side attribution: why-not reason codes and why-here score terms
+computed INSIDE the jitted solve.
+
+The explain scan is a separate lru-cached jitted runner so the canonical
+`simulator._chunk_runner` executable (the one irgate lowers and budgets) is
+byte-for-byte untouched.  Per step it mirrors `simulator._step` exactly —
+same `_feasibility`, same `_sample_scorable`, same argmax over the summed
+`_score_terms` — and additionally emits:
+
+- the chosen node's per-plugin weighted contribution (why-here), gathered
+  from the very terms the argmax summed (no second scoring pass), and
+- a sticky per-node elimination record (why-not): the reason code of each
+  node's first failing plugin in diagnose() priority order, plus the step at
+  which it first became infeasible.
+
+Everything stays on device; the solve's collect point reads the outputs back
+alongside the chosen indices it already syncs.  No callbacks, no extra
+mid-loop round trips (irgate IC001 / perfgate contract).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+from ..engine import encode as enc
+from ..engine import simulator as sim
+from .artifacts import PLUGINS
+
+
+class ExplainState(NamedTuple):
+    carry: sim.Carry
+    elim_step: "jax.Array"   # i32[N]: step of first elimination, -1 = never
+    elim_code: "jax.Array"   # i32[N]: reason code at first elimination
+    step: "jax.Array"        # i32 scalar: global step counter
+
+
+def init_state(carry: sim.Carry) -> ExplainState:
+    import jax.numpy as jnp
+    n = carry.placed.shape[0]
+    return ExplainState(
+        carry=carry,
+        elim_step=jnp.full((n,), -1, dtype=jnp.int32),
+        elim_code=jnp.zeros((n,), dtype=jnp.int32),
+        step=jnp.zeros((), dtype=jnp.int32),
+    )
+
+
+def reason_codes(cfg: sim.StaticConfig, consts, carry: sim.Carry, parts,
+                 static_code):
+    """Per-node first-fail reason code, stamped in diagnose() priority
+    order: static codes -> dynamic ports -> fit -> volume -> volume self
+    conflict -> RWOP -> DRA colocation -> spread (missing label / skew) ->
+    inter-pod affinity.  A node keeps the code of the FIRST plugin that
+    rejected it (codes only stamp where the slot is still CODE_OK), exactly
+    like diagnose()'s `remaining` fold — so expanding these codes on the
+    host reproduces its histogram."""
+    import jax.numpy as jnp
+
+    codes = static_code
+
+    def stamp(codes, mask, code):
+        return jnp.where((codes == enc.CODE_OK) & mask, code, codes)
+
+    if "ports_dyn" in parts:
+        codes = stamp(codes, ~parts["ports_dyn"], enc.CODE_PORTS)
+    fit = parts.get("fit")
+    if fit is not None:
+        codes = stamp(codes, ~fit.mask, enc.CODE_FIT)
+    codes = stamp(codes, ~consts["volume_mask"], enc.CODE_VOLUME)
+    if cfg.volume_self_conflict:
+        codes = stamp(codes, (carry.placed > 0)
+                      & (consts["vol_self_gate"] > 0), enc.CODE_VOLUME_SELF)
+    if cfg.rwop_self_conflict:
+        rw = (carry.placed_count > 0) & (consts["rwop_gate"] > 0)
+        codes = stamp(codes, jnp.broadcast_to(rw, codes.shape), enc.CODE_RWOP)
+    if cfg.dra_shared_colocate:
+        m = (~(carry.placed > 0) & (carry.placed_count > 0)
+             & (consts["dra_colo_gate"] > 0))
+        codes = stamp(codes, m, enc.CODE_DRA)
+    if "spread_missing" in parts:
+        codes = stamp(codes, parts["spread_missing"],
+                      enc.CODE_SPREAD_MISSING_LABEL)
+    if "spread_ok" in parts:
+        codes = stamp(codes, ~parts["spread_ok"], enc.CODE_SPREAD)
+    if "ipa" in parts:
+        f_aff, f_anti, f_eanti = parts["ipa"]
+        codes = stamp(codes, f_aff, enc.CODE_IPA_AFFINITY)
+        codes = stamp(codes, f_anti, enc.CODE_IPA_ANTI)
+        codes = stamp(codes, f_eanti, enc.CODE_IPA_EXISTING_ANTI)
+    return codes
+
+
+def _gather_contribs(cfg, terms, chosen, place):
+    """[len(PLUGINS)] weighted contribution of the chosen node, zero for
+    inactive plugins and for no-op (post-stop / infeasible) steps."""
+    import jax
+    import jax.numpy as jnp
+    dt = sim._dt(cfg)
+    gate = place.astype(dt)
+    by_name = dict(terms)
+    cols = []
+    for name in PLUGINS:
+        term = by_name.get(name)
+        if term is None:
+            cols.append(jnp.zeros((), dtype=dt))
+        else:
+            cols.append(jax.lax.dynamic_slice_in_dim(term, chosen, 1)[0]
+                        * gate)
+    return jnp.stack(cols)
+
+
+def _explain_step(cfg: sim.StaticConfig, consts, static_code,
+                  state: ExplainState):
+    """simulator._step with attribution outputs.  The placement decision
+    replays the canonical step op-for-op (same feasibility, sampling, score
+    fold, and argmax) so the chosen sequence is identical."""
+    import jax
+    import jax.numpy as jnp
+    dt = sim._dt(cfg)
+    carry = state.carry
+
+    feasible, parts = sim._feasibility(cfg, consts, carry)
+    any_feasible = jnp.any(feasible)
+    codes = reason_codes(cfg, consts, carry, parts, static_code)
+
+    scorable, next_start = sim._sample_scorable(cfg, feasible,
+                                                carry.next_start)
+    terms = sim._score_terms(cfg, consts, carry, scorable)
+    n = consts["static_mask"].shape[0]
+    total = jnp.zeros(n, dtype=dt)
+    for _name, term in terms:
+        total = total + term
+
+    neg_one = jnp.asarray(-1.0, dt)
+    keyed = jnp.where(scorable, total, neg_one)
+    if cfg.deterministic:
+        chosen = jnp.argmax(keyed).astype(jnp.int32)
+        rng = carry.rng
+    else:
+        rng, sub = jax.random.split(carry.rng)
+        jitter = jax.random.uniform(sub, keyed.shape, dtype=jnp.float32)
+        chosen = jnp.argmax(keyed + 0.5 * jitter.astype(dt)).astype(jnp.int32)
+
+    place = any_feasible & ~carry.stopped
+    contrib = _gather_contribs(cfg, terms, chosen, place)
+
+    # Sticky elimination record: stamp nodes newly eliminated this step
+    # (while the solve was still live — post-stop states are frozen).
+    newly = ((state.elim_code == enc.CODE_OK) & (codes != enc.CODE_OK)
+             & ~carry.stopped)
+    elim_code = jnp.where(newly, codes, state.elim_code)
+    elim_step = jnp.where(newly, state.step, state.elim_step)
+
+    new_carry = sim._apply_placement(cfg, consts, carry, chosen, place,
+                                     next_start, rng)
+    new_carry = new_carry._replace(stopped=carry.stopped | ~any_feasible)
+    new_state = ExplainState(carry=new_carry, elim_step=elim_step,
+                             elim_code=elim_code, step=state.step + 1)
+    return new_state, (jnp.where(place, chosen, -1), contrib)
+
+
+@functools.lru_cache(maxsize=None)
+def chunk_runner():
+    """Jitted explain scan, cached separately from the canonical runner."""
+    import jax
+
+    @functools.partial(jax.jit, static_argnames=("cfg", "n"))
+    def run_chunk(cfg: sim.StaticConfig, consts, static_code,
+                  state: ExplainState, n: int):
+        def body(s, _):
+            return _explain_step(cfg, consts, static_code, s)
+        return jax.lax.scan(body, state, None, length=n)
+
+    return run_chunk
+
+
+@functools.lru_cache(maxsize=None)
+def final_codes_runner():
+    """Jitted terminal why-not: reason codes plus the fit detail masks
+    (per-resource insufficiency / pod-slot overflow) at a stopping carry.
+    Works for ANY rung's terminal carry — the scan engine hands over its
+    live carry, the fast path its reconstruction."""
+    import jax
+
+    @functools.partial(jax.jit, static_argnames=("cfg",))
+    def run(cfg: sim.StaticConfig, consts, static_code, carry: sim.Carry):
+        import jax.numpy as jnp
+        feasible, parts = sim._feasibility(cfg, consts, carry)
+        codes = reason_codes(cfg, consts, carry, parts, static_code)
+        fit = parts.get("fit")
+        n = codes.shape[0]
+        if fit is not None:
+            insufficient = fit.insufficient
+            too_many = fit.too_many_pods
+        else:
+            insufficient = jnp.zeros((n, 1), dtype=bool)
+            too_many = jnp.zeros((n,), dtype=bool)
+        return codes, insufficient, too_many
+
+    return run

@@ -15,11 +15,15 @@ Counters:
         this is an upper bound on user-visible retraces)
     cc_compile_seconds_total                      backend compile seconds
     cc_trace_spans_dropped_total                  span-buffer overflow
+    cc_explains_total{rung}                       attribution artifacts built
+        per solve rung (explain/artifacts.build_explanation)
 
 Gauges:
     cc_sweep_templates                    templates in the current sweep
     cc_sweep_groups{mode}                 batched/fast_path/sequential groups
     cc_resilience_scenarios{state}        total/completed scenario progress
+    cc_explain_reason_nodes{reason}       nodes per terminal why-not reason
+        in the most recent explained solve
 
 Histograms:
     cc_guard_run_duration_seconds{site,rung,phase}   per-dispatch wall time
@@ -36,3 +40,5 @@ SPANS_DROPPED = "cc_trace_spans_dropped_total"
 SWEEP_TEMPLATES = "cc_sweep_templates"
 SWEEP_GROUPS = "cc_sweep_groups"
 SCENARIOS = "cc_resilience_scenarios"
+EXPLAINS = "cc_explains_total"
+EXPLAIN_REASON_NODES = "cc_explain_reason_nodes"

@@ -121,12 +121,20 @@ def _pad_group(pbs: List[enc.EncodedProblem]) -> tuple:
 
 def sweep(snapshot: ClusterSnapshot, templates: Sequence[dict],
           profile: Optional[SchedulerProfile] = None, max_limit: int = 0,
-          mesh=None, queue_sort: bool = False) -> List[sim.SolveResult]:
+          mesh=None, queue_sort: bool = False,
+          explain: bool = False) -> List[sim.SolveResult]:
     """Solve capacity for every template; batched where possible.
 
     queue_sort=True orders the templates the way the scheduling queue would
     (PrioritySort: priority desc, creation asc — ops/priority_sort.py) before
-    solving; results still align with the INPUT order."""
+    solving; results still align with the INPUT order.
+
+    explain=True attaches full attribution (why-here + why-not + bottleneck)
+    to every result by routing each template through the per-template
+    hardened ladder instead of the batched kernels — attribution is a
+    per-template product, and explain is an opt-in diagnostic mode, so the
+    sweep trades the batched throughput for it.  Placements are identical
+    either way (the rungs are pairwise bit-identical)."""
     profile = profile or SchedulerProfile()
     templates = list(templates)
     if queue_sort:
@@ -137,7 +145,8 @@ def sweep(snapshot: ClusterSnapshot, templates: Sequence[dict],
         for t in order:
             results_by_id[id(t)] = None
         ordered_results = sweep(snapshot, order, profile=profile,
-                                max_limit=max_limit, mesh=mesh)
+                                max_limit=max_limit, mesh=mesh,
+                                explain=explain)
         for t, r in zip(order, ordered_results):
             results_by_id[id(t)] = r
         return [results_by_id[id(t)] for t in templates]
@@ -179,7 +188,11 @@ def sweep(snapshot: ClusterSnapshot, templates: Sequence[dict],
     small_limit = bool(max_limit) and max_limit <= 4096 and mesh is None
     for i in rep_idx:
         pb = problems[i]
-        if not small_limit and fast_path.eligible(pb):
+        if explain:
+            # attribution is a per-template product (why-here needs the
+            # per-step score terms) — the ladder serves every template
+            rest_idx.append(i)
+        elif not small_limit and fast_path.eligible(pb):
             rest_idx.append(i)    # unbounded analytic (pre-mesh semantics)
         elif small_limit and fast_path.eligible_limited(pb):
             key = _group_key(pb, sim.static_config(pb))
@@ -245,7 +258,8 @@ def sweep(snapshot: ClusterSnapshot, templates: Sequence[dict],
                         mode="sequential")
     for i in rest_idx:
         results[i] = degrade.solve_one_guarded(problems[i],
-                                               max_limit=max_limit)
+                                               max_limit=max_limit,
+                                               explain=explain)
     if dup_of:
         import dataclasses as _dc
         for i, j in dup_of.items():
@@ -355,7 +369,7 @@ def _group_uniform(arrs: List[np.ndarray]) -> bool:
 
 
 def solve_group(pbs: List[enc.EncodedProblem], max_limit: int = 0,
-                mesh=None) -> List[sim.SolveResult]:
+                mesh=None, explain: bool = False) -> List[sim.SolveResult]:
     """Public batched-group entry for pre-encoded problems.
 
     The resilience analyzer (resilience/analyzer.py) encodes one problem per
@@ -364,12 +378,17 @@ def solve_group(pbs: List[enc.EncodedProblem], max_limit: int = 0,
     the scenario axis batches exactly like sweep()'s template axis.  Callers
     must pass problems sharing a group key (_group_key) and batchable shape
     (_batchable); sweep() derives those itself.
-    """
-    return _batched_solve(list(pbs), max_limit, mesh=mesh)
+
+    With `explain`, each result carries a why-not Explanation computed from
+    its slice of the batched terminal carry (per-template reason codes +
+    bottleneck).  Why-here attribution is a per-template product — callers
+    wanting it route through the per-template ladder (sweep(explain=True)
+    does exactly that)."""
+    return _batched_solve(list(pbs), max_limit, mesh=mesh, explain=explain)
 
 
 def _batched_solve(pbs: List[enc.EncodedProblem], max_limit: int,
-                   mesh=None) -> List[sim.SolveResult]:
+                   mesh=None, explain: bool = False) -> List[sim.SolveResult]:
     import jax
     import jax.numpy as jnp
 
@@ -382,7 +401,7 @@ def _batched_solve(pbs: List[enc.EncodedProblem], max_limit: int,
         out: List[sim.SolveResult] = []
         for i in range(0, len(pbs), fused_batched.MAX_BATCH):
             out.extend(_batched_solve(pbs[i:i + fused_batched.MAX_BATCH],
-                                      max_limit, mesh=mesh))
+                                      max_limit, mesh=mesh, explain=explain))
         return out
 
     sim._ensure_x64(pbs[0].profile)
@@ -465,27 +484,46 @@ def _batched_solve(pbs: List[enc.EncodedProblem], max_limit: int,
     if max_limit and max_limit > 0:
         placements = [p[:max_limit] for p in placements]
 
+    explain = explain and mesh is None   # sharded carries stay distributed
     if bstate is not None:
         # Unpack the packed planes (a [B, P, S*128] device->host round trip)
         # only when some template actually stopped short of its limit and
-        # needs the carry for diagnose(); pure limit-reached sweeps skip it.
+        # needs the carry for diagnose(), or explain needs terminal codes;
+        # pure limit-reached sweeps skip it.
         stopped = bfused.stopped_flags(bstate)
-        if any(bool(stopped[b])
-               and not (max_limit and len(placements[b]) >= max_limit)
-               for b in range(len(pbs))):
+        if explain or any(bool(stopped[b])
+                          and not (max_limit
+                                   and len(placements[b]) >= max_limit)
+                          for b in range(len(pbs))):
             carry = bfused.unpack(bstate, carry)
     else:
         stopped = np.asarray(carry.stopped)
 
+    def _explain_b(pb, b):
+        # Why-not from this template's slice of the batched terminal carry:
+        # the same jitted final-codes entry every rung shares.  Why-here is
+        # not produced here (per-template product; see solve_group doc).
+        from ..explain import artifacts as _art
+        from ..explain import attribution as _attr
+        carry_b = jax.tree.map(lambda x: x[b], carry)
+        codes, insuff, toomany = _attr.final_codes_runner()(
+            cfg, consts_list[b],
+            jnp.asarray(pb.static_code, dtype=jnp.int32), carry_b)
+        return _art.build_explanation(
+            pb, final_codes=np.asarray(codes),
+            insufficient=np.asarray(insuff), too_many=np.asarray(toomany),
+            rung="fused_batched")
+
     results = []
     for b, pb in enumerate(pbs):
         placed = len(placements[b])
+        expl_obj = _explain_b(pb, b) if explain else None
         if max_limit and placed >= max_limit:
             results.append(sim.SolveResult(
                 placements=placements[b], placed_count=placed,
                 fail_type=sim.FAIL_LIMIT_REACHED,
                 fail_message=f"Maximum number of pods simulated: {max_limit}",
-                node_names=pb.snapshot.node_names))
+                node_names=pb.snapshot.node_names, explain=expl_obj))
         elif stopped[b]:
             carry_b = jax.tree.map(lambda x: x[b], carry)
             counts = sim.diagnose(pb, cfg, consts_list[b], carry_b)
@@ -493,14 +531,15 @@ def _batched_solve(pbs: List[enc.EncodedProblem], max_limit: int,
             results.append(sim.SolveResult(
                 placements=placements[b], placed_count=placed,
                 fail_type=sim.FAIL_UNSCHEDULABLE, fail_message=msg,
-                fail_counts=counts, node_names=pb.snapshot.node_names))
+                fail_counts=counts, node_names=pb.snapshot.node_names,
+                explain=expl_obj))
         else:
             results.append(sim.SolveResult(
                 placements=placements[b], placed_count=placed,
                 fail_type=sim.FAIL_LIMIT_REACHED,
                 fail_message=(f"Simulation step budget exhausted after "
                               f"{placed} placements"),
-                node_names=pb.snapshot.node_names))
+                node_names=pb.snapshot.node_names, explain=expl_obj))
     return results
 
 

@@ -57,6 +57,10 @@ class ScenarioResult:
     # served the headroom solve, and whether any classified fault degraded it
     rung: str = ""
     degraded: bool = False
+    # explain mode (analyze(explain=True)): the degraded cluster's bottleneck
+    # analysis plus the capacity delta vs the intact baseline —
+    # {"totalCapacity", "bindingCounts", "marginal", "deltaCapacity"}
+    bottleneck: Optional[dict] = None
 
 
 def _scenario_to_dict(r: "ScenarioResult") -> dict:
@@ -74,6 +78,8 @@ def _scenario_to_dict(r: "ScenarioResult") -> dict:
            "degraded": r.degraded}
     if r.probe_placements is not None:
         out["probePlacements"] = list(r.probe_placements)
+    if r.bottleneck is not None:
+        out["bottleneck"] = r.bottleneck
     return out
 
 
@@ -90,7 +96,8 @@ def _scenario_from_dict(s: dict) -> "ScenarioResult":
         probe_placements=(list(s["probePlacements"])
                           if s.get("probePlacements") is not None else None),
         rung=s.get("rung", ""),
-        degraded=s.get("degraded", False))
+        degraded=s.get("degraded", False),
+        bottleneck=s.get("bottleneck"))
 
 
 @dataclass
@@ -112,6 +119,9 @@ class SurvivabilityReport:
     collapsed_scenarios: int    # symmetric duplicates not solved separately
     batched_scenarios: int
     sequential_scenarios: int
+    # explain mode: the intact cluster's bottleneck analysis (the reference
+    # every scenario row's deltaCapacity is measured against)
+    baseline_bottleneck: Optional[dict] = None
 
     @property
     def min_k_to_stranded(self) -> Optional[int]:
@@ -166,6 +176,7 @@ class SurvivabilityReport:
                 "minKToZeroHeadroom": self.min_k_to_zero_headroom,
                 "degraded": self.degraded,
                 "worstRung": self.worst_rung,
+                "baselineBottleneck": self.baseline_bottleneck,
                 "worstNodes": [
                     {"nodeName": nm, "headroom": h, "stranded": s}
                     for nm, h, s in self.worst_nodes()],
@@ -188,6 +199,7 @@ class SurvivabilityReport:
             collapsed_scenarios=status["collapsedScenarios"],
             batched_scenarios=status["batchedScenarios"],
             sequential_scenarios=status["sequentialScenarios"],
+            baseline_bottleneck=status.get("baselineBottleneck"),
         )
 
 
@@ -327,7 +339,8 @@ def analyze(snapshot: ClusterSnapshot, scenarios: Sequence[FailureScenario],
             max_limit: int = 0, mesh=None, dedup: bool = True,
             keep_placements: bool = False,
             journal: Optional[str] = None,
-            resume: bool = False) -> SurvivabilityReport:
+            resume: bool = False,
+            explain: bool = False) -> SurvivabilityReport:
     """Run every failure scenario: drain + re-schedule displaced pods, then
     measure remaining probe headroom — batched as ONE device solve per
     problem-shape group when masking is exact, sequential per-scenario
@@ -346,6 +359,12 @@ def analyze(snapshot: ClusterSnapshot, scenarios: Sequence[FailureScenario],
     the already-completed scenarios, so a killed sweep continues instead of
     restarting.  A fingerprint mismatch (different probe/nodes/limit/
     scenario set) raises CheckpointCorruption.
+
+    explain=True annotates every representative scenario with the degraded
+    cluster's bottleneck analysis (explain/bottleneck.py, host-side from the
+    scenario's encoded problem — no extra device work) plus the remaining-
+    capacity delta vs the intact baseline; the baseline analysis rides the
+    report as baseline_bottleneck.
     """
     import os
 
@@ -359,6 +378,26 @@ def analyze(snapshot: ClusterSnapshot, scenarios: Sequence[FailureScenario],
 
     base_pb = enc.encode_problem(snapshot, probe, profile)
     baseline = degrade.solve_one_guarded(base_pb, max_limit=max_limit)
+
+    base_bn = None
+    if explain:
+        from ..explain.bottleneck import bottleneck_analysis
+        base_bn = bottleneck_analysis(base_pb)
+
+    def _scenario_bottleneck(pb: Optional[enc.EncodedProblem]):
+        """Host-side bottleneck for one scenario's encoded problem, plus the
+        capacity delta vs the intact baseline."""
+        if not explain or pb is None:
+            return None
+        from ..explain.bottleneck import bottleneck_analysis
+        bn = bottleneck_analysis(pb)
+        if bn is None:
+            return None
+        if base_bn is not None:
+            bn = dict(bn)
+            bn["deltaCapacity"] = (bn["totalCapacity"]
+                                   - base_bn["totalCapacity"])
+        return bn
 
     dup_of = dedup_single_node(base_pb, scenarios) if dedup else {}
     rep_set = [si for si in range(len(scenarios)) if si not in dup_of]
@@ -414,7 +453,8 @@ def analyze(snapshot: ClusterSnapshot, scenarios: Sequence[FailureScenario],
                         state="completed")
 
     def _complete(si: int, r: sim.SolveResult, *, was_batched: bool,
-                  node_names: List[str]) -> None:
+                  node_names: List[str],
+                  pb: Optional[enc.EncodedProblem] = None) -> None:
         """Assemble a scenario's row and journal it IMMEDIATELY — a sweep
         killed after this point resumes past the scenario."""
         sc, d = scenarios[si], drains[si]
@@ -428,7 +468,8 @@ def analyze(snapshot: ClusterSnapshot, scenarios: Sequence[FailureScenario],
             probe_placements=([node_names[int(i)] for i in r.placements]
                               if keep_placements else None),
             rung=getattr(r, "rung", ""),
-            degraded=getattr(r, "degraded", False))
+            degraded=getattr(r, "degraded", False),
+            bottleneck=_scenario_bottleneck(pb))
         results[si] = row
         _journal(row)
         done_count[0] += 1
@@ -484,7 +525,8 @@ def analyze(snapshot: ClusterSnapshot, scenarios: Sequence[FailureScenario],
                     continue
                 for bi, r in zip(idxs, res):
                     _complete(batch_sis[bi], r, was_batched=True,
-                              node_names=snapshot.node_names)
+                              node_names=snapshot.node_names,
+                              pb=batch_pbs[bi])
 
         for si in seq_sis:
             sc = scenarios[si]
@@ -492,11 +534,11 @@ def analyze(snapshot: ClusterSnapshot, scenarios: Sequence[FailureScenario],
                 snap_del = drains[si].final_deleted_snapshot
                 if snap_del is None:
                     snap_del = _delete_nodes(snapshot, sc.failed)
+                pb_s = enc.encode_problem(snap_del, probe, profile)
                 r = degrade.solve_one_guarded(
-                    enc.encode_problem(snap_del, probe, profile),
-                    max_limit=max_limit, degraded=si in seq_degraded)
+                    pb_s, max_limit=max_limit, degraded=si in seq_degraded)
             _complete(si, r, was_batched=False,
-                      node_names=snap_del.node_names)
+                      node_names=snap_del.node_names, pb=pb_s)
     finally:
         # an interrupted sweep must still leave a well-formed journal —
         # everything completed so far has already been appended and fsynced
@@ -523,4 +565,5 @@ def analyze(snapshot: ClusterSnapshot, scenarios: Sequence[FailureScenario],
         collapsed_scenarios=len(rows) - len(reps),
         batched_scenarios=sum(1 for r in reps if r.batched),
         sequential_scenarios=sum(1 for r in reps if not r.batched),
+        baseline_bottleneck=base_bn,
     )

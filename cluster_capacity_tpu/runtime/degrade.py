@@ -72,10 +72,12 @@ def _record(fault: RuntimeFault, next_rung: str) -> None:
         f"{next_rung}: {fault}")
 
 
-def _solve_oracle(pb, max_limit: int = 0):
+def _solve_oracle(pb, max_limit: int = 0, explain: bool = False):
     """Host-side sequential reference as a SolveResult, reproducing
     sim.solve's budget semantics and failure messages exactly (the parity
     contract tests/test_oracle_parity.py pins the placements)."""
+    import numpy as np
+
     from ..engine import oracle
     from ..engine import simulator as sim
 
@@ -86,48 +88,83 @@ def _solve_oracle(pb, max_limit: int = 0):
                                node_names=[])
     if pb.pod_level_reason:
         n = pb.snapshot.num_nodes
+        expl_obj = None
+        if explain:
+            from ..explain import artifacts as _art
+            expl_obj = _art.build_explanation(
+                pb, histogram={pb.pod_level_reason: n}, rung=RUNG_ORACLE)
         return sim.SolveResult(
             placements=[], placed_count=0,
             fail_type=pb.pod_level_fail_type,
             fail_message=f"0/{n} nodes are available: "
                          f"{pb.pod_level_reason}.",
             fail_counts={pb.pod_level_reason: n},
-            node_names=pb.snapshot.node_names)
+            node_names=pb.snapshot.node_names,
+            explain=expl_obj)
 
     n = pb.snapshot.num_nodes
     cap = max_limit if max_limit and max_limit > 0 \
         else sim._DEFAULT_UNLIMITED_CAP
+    explain_out = {} if explain else None
     placements, counts = oracle.simulate(
-        pb.snapshot, pb.pod, pb.profile, max_limit=cap)
+        pb.snapshot, pb.pod, pb.profile, max_limit=cap,
+        explain_out=explain_out)
     placed = len(placements)
+
+    expl_obj = None
+    if explain:
+        from ..explain import artifacts as _art
+        elim_step = np.asarray(explain_out["elim_step"], dtype=np.int32)
+        why_here = np.asarray(explain_out["why_here"], dtype=np.float64) \
+            if explain_out["why_here"] \
+            else np.zeros((0, len(_art.PLUGINS)))
+        # The oracle attributes eliminations as reason STRINGS, not codes —
+        # codes stay unset.  At an exhausted terminal, `counts` already IS
+        # the all-nodes histogram (with the multi-resource fit expansion);
+        # on limit-reached runs fall back to the first-fail elim reasons.
+        if counts:
+            hist = dict(counts)
+        else:
+            hist = {}
+            for r in explain_out["elim_reason"]:
+                if r:
+                    hist[r] = hist.get(r, 0) + 1
+        expl_obj = _art.build_explanation(
+            pb, why_here=why_here, elim_step=elim_step,
+            histogram=hist,
+            feasible_nodes=int(np.sum(elim_step < 0)),
+            rung=RUNG_ORACLE)
+
     if max_limit and placed >= max_limit:
         return sim.SolveResult(
             placements=placements, placed_count=placed,
             fail_type=sim.FAIL_LIMIT_REACHED,
             fail_message=f"Maximum number of pods simulated: {max_limit}",
-            node_names=pb.snapshot.node_names)
+            node_names=pb.snapshot.node_names, explain=expl_obj)
     if counts:
         return sim.SolveResult(
             placements=placements, placed_count=placed,
             fail_type=sim.FAIL_UNSCHEDULABLE,
             fail_message=sim.format_fit_error(n, counts),
             fail_counts=counts,
-            node_names=pb.snapshot.node_names)
+            node_names=pb.snapshot.node_names, explain=expl_obj)
     return sim.SolveResult(
         placements=placements, placed_count=placed,
         fail_type=sim.FAIL_LIMIT_REACHED,
         fail_message=(f"Simulation step budget exhausted after {placed} "
                       f"placements; set max_limit to bound unlimited "
                       f"profiles"),
-        node_names=pb.snapshot.node_names)
+        node_names=pb.snapshot.node_names, explain=expl_obj)
 
 
 def solve_one_guarded(pb, max_limit: int = 0, *, deadline: float = 0.0,
-                      retries: int = 0, degraded: bool = False):
+                      retries: int = 0, degraded: bool = False,
+                      explain: bool = False):
     """Hardened single-problem solve: full engine → analytic fast path →
     host oracle.  `retries` re-attempts the SAME rung before descending
     (transient device errors); `degraded` pre-marks the result when the
-    caller already fell off a higher rung."""
+    caller already fell off a higher rung.  `explain` threads attribution
+    through whichever rung serves (result.explain records which)."""
     from ..engine import fast_path
     from .. import obs
 
@@ -147,14 +184,16 @@ def solve_one_guarded(pb, max_limit: int = 0, *, deadline: float = 0.0,
 
     with obs.span("degrade.solve_one"):
         result, fault = _attempt(
-            lambda: fast_path.solve_auto(pb, max_limit=max_limit),
+            lambda: fast_path.solve_auto(pb, max_limit=max_limit,
+                                         explain=explain),
             SITE_SOLVE, guard.PHASE_EXECUTE, RUNG_FUSED)
         if fault is None:
             return _stamp(result, RUNG_FUSED, degraded)
 
         _record(fault, RUNG_FAST_PATH)
         result, fp_fault = _attempt(
-            lambda: fast_path.solve_fast(pb, max_limit=max_limit),
+            lambda: fast_path.solve_fast(pb, max_limit=max_limit,
+                                         explain=explain),
             SITE_FAST_PATH, guard.PHASE_EXECUTE, RUNG_FAST_PATH)
         if fp_fault is None and result is not None:
             return _stamp(result, RUNG_FAST_PATH, True)
@@ -167,7 +206,8 @@ def solve_one_guarded(pb, max_limit: int = 0, *, deadline: float = 0.0,
             # path).
             raise fault
         _record(fp_fault or fault, RUNG_ORACLE)
-        result = guard.run(lambda: _solve_oracle(pb, max_limit=max_limit),
+        result = guard.run(lambda: _solve_oracle(pb, max_limit=max_limit,
+                                                 explain=explain),
                            site=SITE_ORACLE, validate_nodes=n,
                            rung=RUNG_ORACLE)
         return _stamp(result, RUNG_ORACLE, True)
@@ -175,7 +215,8 @@ def solve_one_guarded(pb, max_limit: int = 0, *, deadline: float = 0.0,
 
 def solve_group_guarded(pbs, max_limit: int = 0, mesh=None, *,
                         deadline: float = 0.0, retries: int = 0,
-                        degraded: bool = False) -> List:
+                        degraded: bool = False,
+                        explain: bool = False) -> List:
     """Hardened batched group solve.  DeviceOOM splits the group in half
     geometrically (independent sub-batches, bit-identical placements) down
     to B=1; other faults — and B=1 OOM — descend to the per-item ladder."""
@@ -192,7 +233,8 @@ def solve_group_guarded(pbs, max_limit: int = 0, mesh=None, *,
             try:
                 results = guard.run(
                     lambda: sweep_mod.solve_group(pbs, max_limit=max_limit,
-                                                  mesh=mesh),
+                                                  mesh=mesh,
+                                                  explain=explain),
                     site=SITE_GROUP, deadline=deadline,
                     phase=guard.PHASE_COMPILE, validate_nodes=n,
                     rung=RUNG_BATCHED, batch=len(pbs))
@@ -206,13 +248,16 @@ def solve_group_guarded(pbs, max_limit: int = 0, mesh=None, *,
             _record(last, f"{RUNG_BATCHED}[{mid}+{len(pbs) - mid}]")
             left = solve_group_guarded(pbs[:mid], max_limit=max_limit,
                                        mesh=mesh, deadline=deadline,
-                                       retries=retries, degraded=True)
+                                       retries=retries, degraded=True,
+                                       explain=explain)
             right = solve_group_guarded(pbs[mid:], max_limit=max_limit,
                                         mesh=mesh, deadline=deadline,
-                                        retries=retries, degraded=True)
+                                        retries=retries, degraded=True,
+                                        explain=explain)
             return left + right
 
         _record(last, RUNG_FUSED)
         return [solve_one_guarded(pb, max_limit=max_limit, deadline=deadline,
-                                  retries=retries, degraded=True)
+                                  retries=retries, degraded=True,
+                                  explain=explain)
                 for pb in pbs]

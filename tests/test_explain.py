@@ -1,0 +1,250 @@
+"""Placement explainability: attribution parity across rungs + goldens.
+
+The explain artifacts (explain/) are computed inside the jitted solves —
+these tests pin them against the host oracle's independent recomputation:
+
+- why-here (per-placement weighted plugin score contributions) must
+  bit-match between the scan engine, the analytic fast path, and the
+  sequential oracle under the parity profile;
+- why-not (terminal reason codes expanded to reason strings) must equal
+  diagnose()'s fail_counts at every exhausted terminal state;
+- elimination steps must agree between rungs on exhausted runs (a
+  limit-reached scan chunk legitimately runs ahead of the budget);
+- the examples/ snapshot's histogram and bottleneck are golden-pinned.
+"""
+
+import io
+import json
+import os
+
+import numpy as np
+import pytest
+
+from cluster_capacity_tpu import ClusterCapacity, SchedulerProfile
+from cluster_capacity_tpu.engine import encode as enc
+from cluster_capacity_tpu.engine import fast_path
+from cluster_capacity_tpu.engine import simulator as sim
+from cluster_capacity_tpu.explain import Explanation, PLUGINS
+from cluster_capacity_tpu.models.podspec import default_pod
+from cluster_capacity_tpu.models.snapshot import ClusterSnapshot
+from cluster_capacity_tpu.runtime.degrade import _solve_oracle
+
+from helpers import build_test_node, build_test_pod
+from test_fuzz import fuzz_cluster, fuzz_pod
+
+EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples")
+
+
+def _fuzz_problem(seed):
+    rng = np.random.RandomState(seed)
+    n_nodes = int(rng.choice([6, 10, 16]))
+    nodes, pods = fuzz_cluster(rng, n_nodes)
+    pod = default_pod(fuzz_pod(rng))
+    snapshot = ClusterSnapshot.from_objects(
+        nodes, pods, namespaces=[{"metadata": {"name": "default"}}])
+    return enc.encode_problem(snapshot, pod, SchedulerProfile.parity())
+
+
+@pytest.mark.parametrize("seed", range(7100, 7106))
+def test_scan_vs_oracle_attribution(seed):
+    """Differential fuzz: the scan engine's device-computed attribution
+    bit-matches the oracle's sequential host recomputation on exhausted
+    runs (why-here contributions, elimination steps, reason histogram)."""
+    pb = _fuzz_problem(seed)
+    got = sim.solve(pb, explain=True)
+    ref = _solve_oracle(pb, explain=True)
+    assert got.placements == ref.placements, f"seed={seed}"
+    ge, re_ = got.explain, ref.explain
+    assert ge is not None and re_ is not None
+    np.testing.assert_array_equal(ge.why_here, re_.why_here,
+                                  err_msg=f"seed={seed} why_here")
+    if got.fail_type == sim.FAIL_UNSCHEDULABLE:
+        np.testing.assert_array_equal(ge.elim_step, re_.elim_step,
+                                      err_msg=f"seed={seed} elim_step")
+        assert ge.reason_histogram == re_.reason_histogram, f"seed={seed}"
+        assert ge.feasible_nodes == re_.feasible_nodes == 0
+
+
+@pytest.mark.parametrize("seed", range(7100, 7106))
+def test_histogram_equals_diagnose(seed):
+    """At an exhausted terminal the explain histogram IS diagnose()'s
+    fail_counts — the same reason vocabulary over all nodes."""
+    pb = _fuzz_problem(seed)
+    got = sim.solve(pb, explain=True)
+    if got.fail_type == sim.FAIL_UNSCHEDULABLE:
+        assert got.explain.reason_histogram == got.fail_counts
+    plain = sim.solve(pb)
+    assert plain.placements == got.placements
+    assert plain.fail_counts == got.fail_counts
+
+
+def _fast_cluster():
+    nodes = [build_test_node(f"node-{i}", 2000, 4 * 1024 ** 3, 110)
+             for i in range(4)]
+    return ClusterSnapshot.from_objects(nodes)
+
+
+@pytest.mark.parametrize("max_limit", [0, 7])
+def test_fast_path_vs_oracle_attribution(max_limit):
+    """The analytic fast path's attribution (including the synthesized
+    elimination steps) bit-matches both the scan engine and the oracle."""
+    snap = _fast_cluster()
+    pod = default_pod(build_test_pod("p", 150, 100 * 1024 ** 2))
+    pb = enc.encode_problem(snap, pod, SchedulerProfile.parity())
+
+    fast = fast_path.solve_fast(pb, max_limit=max_limit, explain=True)
+    assert fast is not None
+    scan = sim.solve(pb, max_limit=max_limit, explain=True)
+    ref = _solve_oracle(pb, max_limit=max_limit, explain=True)
+    assert fast.placements == scan.placements == ref.placements
+
+    fe, se, re_ = fast.explain, scan.explain, ref.explain
+    np.testing.assert_array_equal(fe.why_here, se.why_here)
+    np.testing.assert_array_equal(fe.why_here, re_.why_here)
+    np.testing.assert_array_equal(fe.final_codes, se.final_codes)
+    np.testing.assert_array_equal(fe.elim_step, se.elim_step)
+    np.testing.assert_array_equal(fe.elim_code, se.elim_code)
+    np.testing.assert_array_equal(fe.elim_step, re_.elim_step)
+    assert fe.reason_histogram == se.reason_histogram
+    if max_limit == 0:
+        assert fe.reason_histogram == re_.reason_histogram \
+            == fast.fail_counts
+
+
+def test_golden_examples_snapshot():
+    """Golden pin for the shipped example: reason histogram, elimination
+    order, and the bottleneck products on examples/cluster-snapshot.yaml."""
+    from cluster_capacity_tpu.utils.snapshot_io import load_snapshot_objects
+    objs = load_snapshot_objects(
+        os.path.join(EXAMPLES, "cluster-snapshot.yaml"))
+    snap = ClusterSnapshot.from_objects(
+        objs.pop("nodes", []), objs.pop("pods", []), **objs)
+    import yaml
+    with open(os.path.join(EXAMPLES, "pod.yaml")) as f:
+        pod = default_pod(yaml.safe_load(f))
+    cc = ClusterCapacity(pod, profile=SchedulerProfile.parity(),
+                         explain=True)
+    cc.set_snapshot(snap)
+    result = cc.run()
+    expl = result.explain
+    assert expl is not None
+    assert result.placed_count == 52
+    assert expl.reason_histogram == {"Insufficient cpu": 4}
+    assert expl.feasible_nodes == 0
+    assert expl.why_here.shape == (52, len(PLUGINS))
+    assert sorted(int(s) for s in expl.elim_step) == [49, 50, 51, 52]
+    bn = expl.bottleneck
+    assert bn is not None
+    assert bn["bindingCounts"] == {"cpu": 4}
+    assert bn["marginal"]["cpu"]["extraPlacements"] == 4
+    assert bn["marginal"]["memory"]["extraPlacements"] == 0
+
+
+def test_explanation_roundtrip():
+    pb = _fuzz_problem(7100)
+    got = sim.solve(pb, explain=True)
+    d1 = got.explain.to_dict()
+    d2 = Explanation.from_dict(json.loads(json.dumps(d1))).to_dict()
+    assert d1 == d2
+
+
+def test_report_carries_reasons_and_explain():
+    """The review's first-class per-run `reasons` block (counts over all
+    nodes) and explain section survive the {"spec","status"} round-trip;
+    the legacy failSummary stays untouched."""
+    from cluster_capacity_tpu.utils.report import (ClusterCapacityReview,
+                                                   print_review)
+    nodes = [build_test_node(f"n{i}", 2000, 4 * 1024 ** 3, 110)
+             for i in (1, 2)]
+    cc = ClusterCapacity(default_pod(build_test_pod("p", 500, 1024 ** 3)),
+                         profile=SchedulerProfile.parity(), explain=True)
+    cc.sync_with_objects(nodes)
+    cc.run()
+    d1 = cc.report().to_dict()
+    pod = d1["status"]["pods"][0]
+    assert pod["failSummary"]            # legacy field intact
+    assert pod["reasons"] == {fs["reason"]: fs["count"]
+                              for fs in pod["failSummary"]}
+    assert pod["explain"]["reasons"] == pod["reasons"]
+    assert pod["explain"]["rung"]
+    d2 = ClusterCapacityReview.from_dict(
+        json.loads(json.dumps(d1))).to_dict()
+    assert d1 == d2
+    buf = io.StringIO()
+    print_review(cc.report(), verbose=True, out=buf)
+    assert "Explainability for p" in buf.getvalue()
+
+
+def test_resilience_explain_bottleneck_deltas():
+    """analyze(explain=True) annotates every scenario with the degraded
+    cluster's bottleneck and the capacity delta vs the intact baseline,
+    and the envelope still round-trips (journal back-compat: rows without
+    the field parse as bottleneck=None)."""
+    from cluster_capacity_tpu.resilience import analyze, single_node_scenarios
+    from cluster_capacity_tpu.resilience.analyzer import _scenario_from_dict
+    from cluster_capacity_tpu.utils.report import survivability_from_dict
+    nodes = [build_test_node(f"n{i}", 2000, 4 * 1024 ** 3, 8)
+             for i in range(3)]
+    snap = ClusterSnapshot.from_objects(
+        nodes, [build_test_pod("resident", 500, 0, node_name="n0")])
+    probe = default_pod(build_test_pod("probe", 500, 0))
+    report = analyze(snap, single_node_scenarios(snap), probe,
+                     profile=SchedulerProfile(), explain=True)
+    assert report.baseline_bottleneck is not None
+    base_cap = report.baseline_bottleneck["totalCapacity"]
+    for r in report.scenarios:
+        assert r.bottleneck is not None, r.name
+        assert r.bottleneck["deltaCapacity"] \
+            == r.bottleneck["totalCapacity"] - base_cap
+    data = json.loads(json.dumps(report.to_dict()))
+    assert survivability_from_dict(data).to_dict() == data
+    # pre-explain journal rows (no bottleneck key) still parse
+    legacy = dict(data["status"]["scenarios"][0])
+    legacy.pop("bottleneck", None)
+    assert _scenario_from_dict(legacy).bottleneck is None
+
+
+def test_explain_cli_smoke(capsys):
+    """The `explain` subcommand renders all three products and its json
+    mode emits the machine-readable artifact."""
+    from cluster_capacity_tpu.cli import hypercc
+    rc = hypercc.run(["explain",
+                      "--snapshot",
+                      os.path.join(EXAMPLES, "cluster-snapshot.yaml"),
+                      "--podspec", os.path.join(EXAMPLES, "pod.yaml"),
+                      "--parity"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "Why not" in out and "Why here" in out and "Bottleneck" in out
+    rc = hypercc.run(["explain",
+                      "--snapshot",
+                      os.path.join(EXAMPLES, "cluster-snapshot.yaml"),
+                      "--podspec", os.path.join(EXAMPLES, "pod.yaml"),
+                      "--parity", "-o", "json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert doc["placed"] == 52
+    assert doc["explain"]["reasons"] == {"Insufficient cpu": 4}
+    assert len(doc["nodes"]) == 4
+
+
+def test_trend_tool(tmp_path):
+    """tools/trend merges per-round artifacts and flags >10% throughput
+    drops between consecutive rounds."""
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from tools.trend import collect, regressions
+    root = str(tmp_path)
+    for n, pps in ((1, 1000.0), (2, 800.0)):
+        with open(os.path.join(root, f"BENCH_r{n:02d}.json"), "w") as f:
+            json.dump({"n": n, "parsed": {
+                "metric": "demo_placements_per_sec", "value": pps,
+                "unit": "placements/s"}}, f)
+    with open(os.path.join(root, "MULTICHIP_r01.json"), "w") as f:
+        json.dump({"n_devices": 8, "ok": True, "skipped": False}, f)
+    data = collect(root)
+    assert data["metrics"]["demo_placements_per_sec"] == {1: 1000.0,
+                                                          2: 800.0}
+    assert data["metrics"]["multichip_ok"] == {1: 1.0}
+    regs = regressions(data)
+    assert len(regs) == 1 and regs[0]["drop_pct"] == 20.0

@@ -22,6 +22,9 @@ HOT_DIR_PREFIXES = (
     # telemetry taps run inside the dispatch choke point: a host sync here
     # would stall every guarded call, so obs/ is policed as hot
     "cluster_capacity_tpu/obs/",
+    # attribution is computed inside the jitted solves; the host-side
+    # artifact/bottleneck modules must stay dispatch-free aggregation code
+    "cluster_capacity_tpu/explain/",
 )
 
 # Function qualnames allowed to synchronize with the device.  A sync call

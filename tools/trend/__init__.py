@@ -1,0 +1,172 @@
+"""Cross-round trend table over the committed CI artifacts.
+
+Every CI round leaves numbered artifacts at the repo root — BENCH_rNN.json
+(bench.py's parsed metric line), MULTICHIP_rNN.json (the multi-device
+dry-run verdict) — and the gates can add their --json-out reports
+(IRGATE.json, PERFGATE.json).  This tool merges them into ONE per-metric
+trend table across rounds, so a reviewer reads the whole performance
+history in a glance instead of diffing five JSON files, and flags
+cross-round regressions (a throughput metric dropping more than
+REGRESSION_PCT between the two most recent rounds that report it).
+
+Outputs TREND.md (markdown table) and TREND.json (machine-readable rows).
+Wired as `make trend`.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# throughput metrics: a drop beyond this between consecutive reporting
+# rounds is flagged as a regression (matching bench.py's own -10% warning)
+REGRESSION_PCT = 10.0
+_RATE_SUFFIXES = ("_per_sec",)
+
+# bench keys that are provenance, not metrics
+_NON_METRIC_KEYS = {"metric", "value", "unit", "platform", "probe_outcome",
+                    "scan_engine_fused_kernel", "scan_engine_fused_ipa",
+                    "sweep_batched_fused_kernel"}
+
+
+def _round_of(path: str) -> Optional[int]:
+    m = re.search(r"_r(\d+)\.json$", os.path.basename(path))
+    return int(m.group(1)) if m else None
+
+
+def _artifact_files(root: str, pattern: str) -> List[Tuple[int, str]]:
+    out = []
+    for p in glob.glob(os.path.join(root, pattern)):
+        n = _round_of(p)
+        if n is not None:
+            out.append((n, p))
+    return sorted(out)
+
+
+def _load(path: str) -> Optional[dict]:
+    try:
+        with open(path, encoding="utf-8") as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None
+
+
+def collect(root: str = ROOT) -> dict:
+    """{"rounds": [..], "metrics": {name: {round: value}}, "gates": {...}}.
+
+    Bench rounds contribute their headline metric (parsed["metric"] →
+    parsed["value"]) plus every other numeric key of the parsed line;
+    multichip rounds contribute multichip_ok / multichip_devices.  Gate
+    reports (IRGATE.json / PERFGATE.json, when CI committed them) ride
+    along un-rounded as current-state verdicts.
+    """
+    rounds: set = set()
+    metrics: Dict[str, Dict[int, float]] = {}
+
+    def put(name: str, rnd: int, value) -> None:
+        if isinstance(value, bool):
+            value = int(value)
+        if not isinstance(value, (int, float)):
+            return
+        metrics.setdefault(name, {})[rnd] = float(value)
+        rounds.add(rnd)
+
+    for rnd, path in _artifact_files(root, "BENCH_r*.json"):
+        doc = _load(path)
+        parsed = (doc or {}).get("parsed")
+        if not isinstance(parsed, dict):
+            continue
+        headline = parsed.get("metric")
+        if headline and isinstance(parsed.get("value"), (int, float)):
+            put(str(headline), rnd, parsed["value"])
+        for k, v in parsed.items():
+            if k not in _NON_METRIC_KEYS:
+                put(k, rnd, v)
+
+    for rnd, path in _artifact_files(root, "MULTICHIP_r*.json"):
+        doc = _load(path)
+        if not doc:
+            continue
+        if doc.get("skipped"):
+            continue
+        put("multichip_ok", rnd, bool(doc.get("ok")))
+        if doc.get("n_devices"):
+            put("multichip_devices", rnd, doc["n_devices"])
+
+    gates = {}
+    for name, fname in (("irgate", "IRGATE.json"),
+                        ("perfgate", "PERFGATE.json")):
+        doc = _load(os.path.join(root, fname))
+        if doc is not None:
+            gates[name] = {"clean": bool(doc.get("clean")),
+                           "findings": len(doc.get("findings") or [])}
+
+    return {"rounds": sorted(rounds), "metrics": metrics, "gates": gates}
+
+
+def regressions(data: dict) -> List[dict]:
+    """Throughput metrics whose most recent reporting round dropped more
+    than REGRESSION_PCT below the round before it."""
+    out = []
+    for name, series in sorted(data["metrics"].items()):
+        if not name.endswith(_RATE_SUFFIXES):
+            continue
+        rnds = sorted(series)
+        if len(rnds) < 2:
+            continue
+        prev, cur = series[rnds[-2]], series[rnds[-1]]
+        if prev > 0 and cur < prev * (1 - REGRESSION_PCT / 100.0):
+            out.append({
+                "metric": name,
+                "from_round": rnds[-2], "to_round": rnds[-1],
+                "before": prev, "after": cur,
+                "drop_pct": round(100.0 * (1 - cur / prev), 1),
+            })
+    return out
+
+
+def _fmt(v: Optional[float]) -> str:
+    if v is None:
+        return "-"
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return f"{v:.2f}"
+
+
+def render_markdown(data: dict, regs: List[dict]) -> str:
+    rounds = data["rounds"]
+    lines = ["# Metric trend across CI rounds", ""]
+    if not rounds:
+        lines.append("No per-round artifacts found (BENCH_r*.json / "
+                     "MULTICHIP_r*.json).")
+        return "\n".join(lines) + "\n"
+    head = "| metric | " + " | ".join(f"r{r:02d}" for r in rounds) + " |"
+    sep = "|---" * (len(rounds) + 1) + "|"
+    lines += [head, sep]
+    for name in sorted(data["metrics"]):
+        series = data["metrics"][name]
+        cells = " | ".join(_fmt(series.get(r)) for r in rounds)
+        lines.append(f"| {name} | {cells} |")
+    if data["gates"]:
+        lines += ["", "## Gate verdicts (current tree)", ""]
+        for name, g in sorted(data["gates"].items()):
+            verdict = "clean" if g["clean"] else (
+                f"{g['findings']} finding(s)")
+            lines.append(f"- **{name}**: {verdict}")
+    lines += ["", "## Regressions", ""]
+    if regs:
+        for r in regs:
+            lines.append(
+                f"- **{r['metric']}**: {_fmt(r['before'])} → "
+                f"{_fmt(r['after'])} (-{r['drop_pct']}% between "
+                f"r{r['from_round']:02d} and r{r['to_round']:02d})")
+    else:
+        lines.append("none flagged (throughput metrics within "
+                     f"{REGRESSION_PCT:g}% of the previous round)")
+    return "\n".join(lines) + "\n"

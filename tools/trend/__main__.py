@@ -1,0 +1,55 @@
+"""python -m tools.trend — merge per-round CI artifacts into a trend table.
+
+  --root DIR       artifact directory (default: repo root)
+  --md-out FILE    markdown table (default: <root>/TREND.md)
+  --json-out FILE  machine-readable rows (default: <root>/TREND.json)
+  --check          exit 1 when a cross-round regression is flagged
+  --quiet          suppress the table on stdout
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from . import ROOT, collect, regressions, render_markdown
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m tools.trend")
+    ap.add_argument("--root", default=ROOT)
+    ap.add_argument("--md-out", dest="md_out", default="")
+    ap.add_argument("--json-out", dest="json_out", default="")
+    ap.add_argument("--check", action="store_true")
+    ap.add_argument("--quiet", action="store_true")
+    args = ap.parse_args(argv)
+
+    import os
+    data = collect(args.root)
+    regs = regressions(data)
+    md = render_markdown(data, regs)
+
+    md_out = args.md_out or os.path.join(args.root, "TREND.md")
+    json_out = args.json_out or os.path.join(args.root, "TREND.json")
+    with open(md_out, "w", encoding="utf-8") as fh:
+        fh.write(md)
+    with open(json_out, "w", encoding="utf-8") as fh:
+        json.dump({"trend": 1, "rounds": data["rounds"],
+                   "metrics": data["metrics"], "gates": data["gates"],
+                   "regressions": regs}, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+    if not args.quiet:
+        sys.stdout.write(md)
+    sys.stdout.write(f"trend: {len(data['metrics'])} metric(s) across "
+                     f"{len(data['rounds'])} round(s) -> "
+                     f"{os.path.basename(md_out)}, "
+                     f"{os.path.basename(json_out)}; "
+                     f"{len(regs)} regression(s) flagged\n")
+    return 1 if (args.check and regs) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
