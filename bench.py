@@ -587,11 +587,63 @@ def _scenario_resilience():
             "warmup_s": round(warmup, 3), "steady_s": round(dt, 3)}
 
 
+def _scenario_bounds():
+    """Bound-guided resilience sweep vs the unbounded sweep on the same
+    128-node N-1 shape as _scenario_resilience: the capacity bracket
+    (bounds/bracket.py) proves most single-node scenarios without a device
+    solve, so the bounded sweep should be well faster end-to-end while
+    producing row-identical results.  Reports the pruned fraction, both
+    steady times, and the bounded sweep's proved-placements throughput."""
+    from cluster_capacity_tpu.models.podspec import default_pod
+    from cluster_capacity_tpu.models.snapshot import ClusterSnapshot
+    from cluster_capacity_tpu.resilience import analyze, single_node_scenarios
+    from cluster_capacity_tpu.utils.config import SchedulerProfile
+
+    n_nodes = int(os.environ.get("BENCH_RESILIENCE_NODES", "128"))
+    limit = int(os.environ.get("BENCH_RESILIENCE_LIMIT", "256"))
+    snapshot = ClusterSnapshot.from_objects(
+        _make_nodes(n_nodes=n_nodes, seed=11))
+    probe = default_pod({
+        "metadata": {"name": "bench-probe"},
+        "spec": {"containers": [{
+            "name": "c0", "resources": {"requests": {
+                "cpu": "100m", "memory": "256Mi"}}}]},
+    })
+    profile = SchedulerProfile()
+    scenarios = single_node_scenarios(snapshot)
+
+    def _run(bounds):
+        analyze(snapshot, scenarios, probe, profile=profile,      # warmup
+                max_limit=limit, dedup=False, bounds=bounds)
+        t0 = time.perf_counter()
+        rep = analyze(snapshot, scenarios, probe, profile=profile,
+                      max_limit=limit, dedup=False, bounds=bounds)
+        return rep, time.perf_counter() - t0
+
+    unbounded, dt_un = _run(False)
+    bounded, dt_b = _run(True)
+
+    def _rows(rep):
+        # identity modulo the bookkeeping the bracket path stamps
+        return [(r.name, r.displaced, r.replaced, r.stranded, r.preempted,
+                 r.headroom, r.fail_message) for r in rep.scenarios]
+
+    pruned = sum(1 for r in bounded.scenarios if r.bounded_of is not None)
+    placed = sum(r.headroom for r in bounded.scenarios)
+    return {"pps": placed / dt_b,
+            "pruned_fraction": pruned / len(scenarios),
+            "rows_identical": _rows(bounded) == _rows(unbounded),
+            "speedup": dt_un / dt_b,
+            "unbounded_s": round(dt_un, 3), "steady_s": round(dt_b, 3),
+            "nodes": n_nodes, "scenarios": len(scenarios), "pruned": pruned}
+
+
 _SCENARIOS = {"fast": _scenario_fast, "scan": _scenario_scan,
               "ipa": _scenario_ipa, "sweep": _scenario_sweep,
               "c5": _scenario_c5,
               "interleave": _scenario_interleave,
               "resilience": _scenario_resilience,
+              "bounds": _scenario_bounds,
               "parity": _scenario_parity}
 
 
@@ -678,6 +730,7 @@ def main() -> None:
                        int(os.environ.get("BENCH_C5_TIMEOUT", "1200")))
     il = _run_scenario("interleave", accel, timeout)
     res = _run_scenario("resilience", accel, timeout)
+    bnd = _run_scenario("bounds", accel, timeout)
     par = _run_scenario("parity", accel, timeout)
 
     platform = (sc or fp or ipa or sw or {}).get("platform", "none")
@@ -730,6 +783,13 @@ def main() -> None:
         out["resilience_scenarios"] = res["scenarios"]
         out["resilience_batched"] = res["batched"]
         out["resilience_collapsed"] = res["collapsed"]
+    if bnd:
+        out["bounds_sweep_placements_per_sec"] = round(bnd["pps"], 2)
+        out["bounds_sweep_pruned_fraction"] = round(
+            bnd["pruned_fraction"], 4)
+        out["bounds_sweep_rows_identical"] = bnd["rows_identical"]
+        out["bounds_sweep_speedup_vs_unbounded"] = round(bnd["speedup"], 2)
+        out["bounds_sweep_unbounded_s"] = bnd["unbounded_s"]
     if par:
         out["parity_f32_matches_f64"] = par["f32_matches_f64"]
         out["parity_steps_compared"] = par["steps_compared"]
@@ -741,7 +801,8 @@ def main() -> None:
     # artifact and in perfgate failure messages.
     phases = {}
     for name, d in (("fast", fp), ("scan", sc), ("ipa", ipa), ("sweep", sw),
-                    ("c5", c5), ("interleave", il), ("resilience", res)):
+                    ("c5", c5), ("interleave", il), ("resilience", res),
+                    ("bounds", bnd)):
         if not d:
             continue
         ph = {k: d[k] for k in ("warmup_s", "steady_s", "steady_reps_s",

@@ -1,0 +1,20 @@
+"""Joint packing bounds: batched capacity bracketing (ROADMAP item 4).
+
+A cheap relaxation brackets every solve's answer before the scan runs:
+an LP-style fractional upper bound over the fit encodings and a K-round
+FFD/auction constructive lower bound, both computed in one jitted device
+kernel vmapped over the sweep's {scenario, template} axes.  Integration
+(resilience pruning, sweep/scan budget right-sizing) lives with the
+callers; the bracket math lives here.
+"""
+
+from .bracket import (UNBOUNDED, CapacityBracket, auction_device,
+                      bracket_device, bracket_group, bracket_host,
+                      bracket_mix, exact_capacity, exhausted_fit_counts,
+                      upper_bound_host)
+
+__all__ = [
+    "UNBOUNDED", "CapacityBracket", "auction_device", "bracket_device",
+    "bracket_group", "bracket_host", "bracket_mix", "exact_capacity",
+    "exhausted_fit_counts", "upper_bound_host",
+]

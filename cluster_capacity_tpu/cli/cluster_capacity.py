@@ -72,6 +72,11 @@ def build_parser(prog: str = "cluster-capacity") -> argparse.ArgumentParser:
                         "per-placement why-here plugin score contributions, "
                         "and the bottleneck analysis.  Surfaces in the "
                         "report's explain section (verbose/json/yaml).")
+    p.add_argument("--no-bounds", dest="no_bounds", action="store_true",
+                   help="Disable bound-guided scan-budget right-sizing "
+                        "(bounds/bracket.py): solves keep the full step "
+                        "budget instead of clamping to the capacity upper "
+                        "bound.  Placements are identical either way.")
     p.add_argument("--trace", action="store_true",
                    help="Print phase trace spans (snapshotting / scan) to "
                         "stderr, mirroring the reference's utiltrace spans.")
@@ -267,7 +272,8 @@ def run(argv: Optional[List[str]] = None, prog: str = "cluster-capacity") -> int
         if len(pods) == 1:
             cc = ClusterCapacity(pods[0], max_limit=args.max_limit,
                                  profile=profile, exclude_nodes=exclude,
-                                 explain=args.explain)
+                                 explain=args.explain,
+                                 bounds=not args.no_bounds)
             snap, raw_objs, snap_opts = current_snapshot()
             if snap is not None:
                 cc.set_snapshot(snap, **snap_opts)
@@ -311,7 +317,8 @@ def run(argv: Optional[List[str]] = None, prog: str = "cluster-capacity") -> int
             else:
                 results = sweep(snapshot, pods, profile=profile,
                                 max_limit=args.max_limit,
-                                explain=args.explain)
+                                explain=args.explain,
+                                bounds=not args.no_bounds)
         reg = metrics_mod.default_registry
         for r in results:
             reg.inc(metrics_mod.SCHEDULE_ATTEMPTS, amount=r.placed_count,

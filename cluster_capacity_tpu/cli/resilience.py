@@ -78,6 +78,11 @@ def build_parser(prog: str = "resilience") -> argparse.ArgumentParser:
     p.add_argument("--no-dedup", dest="no_dedup", action="store_true",
                    help="Solve every scenario separately instead of "
                         "collapsing symmetric single-node failures.")
+    p.add_argument("--no-bounds", dest="no_bounds", action="store_true",
+                   help="Disable bound-guided pruning and budget "
+                        "right-sizing (bounds/bracket.py): every scenario "
+                        "runs an exact device solve even when its capacity "
+                        "bracket already proves the row.")
     p.add_argument("--verbose", action="store_true", help="Verbose mode")
     p.add_argument("-o", "--output", default="",
                    help="Output format. One of: json|yaml.")
@@ -200,7 +205,7 @@ def run(argv: Optional[List[str]] = None, prog: str = "resilience") -> int:
         report = analyze(snapshot, scenarios, probe, profile=profile,
                          max_limit=args.max_limit, dedup=not args.no_dedup,
                          journal=args.journal or None, resume=args.resume,
-                         explain=args.explain)
+                         explain=args.explain, bounds=not args.no_bounds)
     except CheckpointCorruption as e:
         print(f"Error: {e}", file=sys.stderr)
         return 1

@@ -405,14 +405,14 @@ def _explain_fast(pb, cfg, consts, carry, comp, order, chosen_nodes, caps,
 
 
 def solve_auto(pb: enc.EncodedProblem, max_limit: int = 0,
-               chunk_size: int = 1024, explain: bool = False
-               ) -> sim.SolveResult:
+               chunk_size: int = 1024, explain: bool = False,
+               bounds: bool = True) -> sim.SolveResult:
     """Fast path when exact, scan engine otherwise — identical results."""
     result = solve_fast(pb, max_limit=max_limit, explain=explain)
     if result is not None:
         return result
     return sim.solve(pb, max_limit=max_limit, chunk_size=chunk_size,
-                     explain=explain)
+                     explain=explain, bounds=bounds)
 
 
 # --------------------------------------------------------------------------

@@ -729,8 +729,8 @@ def _ensure_x64(profile):
 
 
 def solve(pb: enc.EncodedProblem, max_limit: int = 0,
-          chunk_size: int = 1024, mesh=None, explain: bool = False
-          ) -> SolveResult:
+          chunk_size: int = 1024, mesh=None, explain: bool = False,
+          bounds: bool = True) -> SolveResult:
     """Run the greedy placement loop to completion.
 
     The scan runs in fixed-size chunks of a jitted `lax.scan`; chunks repeat
@@ -748,7 +748,13 @@ def solve(pb: enc.EncodedProblem, max_limit: int = 0,
     outputs read back at the same per-chunk sync the solve already pays; the
     fused Pallas drive is skipped (it packs the carry in kernel-private
     layout and exposes no per-step score terms).  `explain` is ignored on
-    mesh-sharded solves."""
+    mesh-sharded solves.
+
+    With `bounds` (default), the step budget is clamped to the capacity
+    upper bound + 1 (bounds/bracket.py) so unlimited-profile solves stop
+    scanning right after saturation instead of burning the full hint;
+    placements and messages are unchanged — the bound always admits the
+    exhaustion step."""
     import jax
     import numpy as np
 
@@ -790,6 +796,14 @@ def solve(pb: enc.EncodedProblem, max_limit: int = 0,
     if max_limit and max_limit > 0:
         budget = min(max_limit, budget)
     budget = max(1, min(budget, _DEFAULT_UNLIMITED_CAP))
+    if bounds:
+        # right-size against the capacity upper bound (bounds/bracket.py,
+        # host f64 — same caps formula the fast path uses): the scan cannot
+        # place more than `upper` clones, so the final chunk stops wasting
+        # steps past saturation.  +1 keeps one step past the bound so the
+        # scan still discovers exhaustion and emits the FitError message.
+        from ..bounds.bracket import upper_bound_host
+        budget = max(1, min(budget, upper_bound_host(pb) + 1))
     # Chunks always run at full length (steps no-op once stopped) so one
     # compiled executable serves every solve of this shape; placements are
     # trimmed to the budget afterwards.

@@ -26,12 +26,15 @@ class ClusterCapacity:
     def __init__(self, pod: dict, max_limit: int = 0,
                  profile: Optional[SchedulerProfile] = None,
                  exclude_nodes: Sequence[str] = (),
-                 explain: bool = False):
+                 explain: bool = False,
+                 bounds: bool = True):
         self.pod = pod
         self.max_limit = max_limit
         self.profile = profile or SchedulerProfile()
         self.exclude_nodes = list(exclude_nodes)
         self.explain = explain
+        # bound-guided scan budgets (bounds/bracket.py); False = --no-bounds
+        self.bounds = bounds
         self.snapshot: Optional[ClusterSnapshot] = None
         self._result: Optional[SolveResult] = None
         self._final_snapshot: Optional[ClusterSnapshot] = None
@@ -175,7 +178,8 @@ class ClusterCapacity:
                     validate_nodes=problem.snapshot.num_nodes)
             else:
                 result = solve_one_guarded(problem, max_limit=remaining,
-                                           explain=self.explain)
+                                           explain=self.explain,
+                                           bounds=self.bounds)
             cycle_results.append(result)
             placements.extend(result.placements)
             if result.fail_type != "Unschedulable" or not preempt_on:
@@ -245,7 +249,8 @@ class ClusterCapacity:
         if result is None:
             result = solve_one_guarded(
                 encode_problem(snapshot, self.pod, profile),
-                max_limit=self.max_limit, explain=self.explain)
+                max_limit=self.max_limit, explain=self.explain,
+                bounds=self.bounds)
             cycle_results.append(result)
         # a preemption loop spans several solves: the report's provenance is
         # the WORST rung any cycle fell to, degraded if any cycle was

@@ -122,7 +122,8 @@ def _pad_group(pbs: List[enc.EncodedProblem]) -> tuple:
 def sweep(snapshot: ClusterSnapshot, templates: Sequence[dict],
           profile: Optional[SchedulerProfile] = None, max_limit: int = 0,
           mesh=None, queue_sort: bool = False,
-          explain: bool = False) -> List[sim.SolveResult]:
+          explain: bool = False,
+          bounds: bool = True) -> List[sim.SolveResult]:
     """Solve capacity for every template; batched where possible.
 
     queue_sort=True orders the templates the way the scheduling queue would
@@ -146,7 +147,7 @@ def sweep(snapshot: ClusterSnapshot, templates: Sequence[dict],
             results_by_id[id(t)] = None
         ordered_results = sweep(snapshot, order, profile=profile,
                                 max_limit=max_limit, mesh=mesh,
-                                explain=explain)
+                                explain=explain, bounds=bounds)
         for t, r in zip(order, ordered_results):
             results_by_id[id(t)] = r
         return [results_by_id[id(t)] for t in templates]
@@ -250,7 +251,8 @@ def sweep(snapshot: ClusterSnapshot, templates: Sequence[dict],
             rest_idx.append(idxs[0])
             continue
         batch_results = degrade.solve_group_guarded(
-            [problems[i] for i in idxs], max_limit=max_limit, mesh=mesh)
+            [problems[i] for i in idxs], max_limit=max_limit, mesh=mesh,
+            bounds=bounds)
         for i, r in zip(idxs, batch_results):
             results[i] = r
 
@@ -259,7 +261,8 @@ def sweep(snapshot: ClusterSnapshot, templates: Sequence[dict],
     for i in rest_idx:
         results[i] = degrade.solve_one_guarded(problems[i],
                                                max_limit=max_limit,
-                                               explain=explain)
+                                               explain=explain,
+                                               bounds=bounds)
     if dup_of:
         import dataclasses as _dc
         for i, j in dup_of.items():
@@ -369,7 +372,8 @@ def _group_uniform(arrs: List[np.ndarray]) -> bool:
 
 
 def solve_group(pbs: List[enc.EncodedProblem], max_limit: int = 0,
-                mesh=None, explain: bool = False) -> List[sim.SolveResult]:
+                mesh=None, explain: bool = False,
+                bounds: bool = True) -> List[sim.SolveResult]:
     """Public batched-group entry for pre-encoded problems.
 
     The resilience analyzer (resilience/analyzer.py) encodes one problem per
@@ -384,11 +388,13 @@ def solve_group(pbs: List[enc.EncodedProblem], max_limit: int = 0,
     bottleneck).  Why-here attribution is a per-template product — callers
     wanting it route through the per-template ladder (sweep(explain=True)
     does exactly that)."""
-    return _batched_solve(list(pbs), max_limit, mesh=mesh, explain=explain)
+    return _batched_solve(list(pbs), max_limit, mesh=mesh, explain=explain,
+                          bounds=bounds)
 
 
 def _batched_solve(pbs: List[enc.EncodedProblem], max_limit: int,
-                   mesh=None, explain: bool = False) -> List[sim.SolveResult]:
+                   mesh=None, explain: bool = False,
+                   bounds: bool = True) -> List[sim.SolveResult]:
     import jax
     import jax.numpy as jnp
 
@@ -401,7 +407,8 @@ def _batched_solve(pbs: List[enc.EncodedProblem], max_limit: int,
         out: List[sim.SolveResult] = []
         for i in range(0, len(pbs), fused_batched.MAX_BATCH):
             out.extend(_batched_solve(pbs[i:i + fused_batched.MAX_BATCH],
-                                      max_limit, mesh=mesh, explain=explain))
+                                      max_limit, mesh=mesh, explain=explain,
+                                      bounds=bounds))
         return out
 
     sim._ensure_x64(pbs[0].profile)
@@ -433,7 +440,17 @@ def _batched_solve(pbs: List[enc.EncodedProblem], max_limit: int,
         carry = mesh_lib.shard_carry(mesh, carry, batched=True)
     consts = (shared, stacked)
 
-    budget = max(pb.max_steps_hint for pb in pbs) + 1
+    if bounds:
+        # right-size the group budget from the per-template capacity upper
+        # bounds (bounds/bracket.py, host f64): the group scans until its
+        # LAST template saturates, so the max over (hint, bound)-clamped
+        # per-template budgets shaves every step past the slowest template's
+        # provable saturation.  +1 keeps the exhaustion-discovery step.
+        from ..bounds.bracket import upper_bound_host
+        budget = max(min(pb.max_steps_hint, upper_bound_host(pb))
+                     for pb in pbs) + 1
+    else:
+        budget = max(pb.max_steps_hint for pb in pbs) + 1
     if max_limit and max_limit > 0:
         budget = min(max_limit, budget)
     budget = max(1, min(budget, sim._DEFAULT_UNLIMITED_CAP))

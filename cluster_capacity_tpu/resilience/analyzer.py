@@ -37,6 +37,10 @@ from ..parallel import sweep
 from ..utils.config import SchedulerProfile
 from .scenarios import FailureScenario, dedup_single_node
 
+# Provenance stamp for rows proved by their capacity bracket without a
+# device solve — sits alongside (not inside) runtime/degrade.LADDER.
+RUNG_BOUNDS = "bounds"
+
 
 @dataclass
 class ScenarioResult:
@@ -52,6 +56,11 @@ class ScenarioResult:
     fail_message: str = ""
     batched: bool = False       # solved via the masked batched path
     deduped_of: Optional[str] = None   # metrics copied from this scenario
+    # bound-guided pruning (bounds/bracket.py): the bracket rule that proved
+    # the row without a device solve — "lower==upper" (tight bracket, row
+    # recomputed from the exact per-node caps) or "lower>=limit" (the
+    # constructive lower bound already reaches max_limit)
+    bounded_of: Optional[str] = None
     probe_placements: Optional[List[str]] = None  # node names, when kept
     # hardened-runtime provenance (runtime/degrade.py): the ladder rung that
     # served the headroom solve, and whether any classified fault degraded it
@@ -74,6 +83,7 @@ def _scenario_to_dict(r: "ScenarioResult") -> dict:
            "failMessage": r.fail_message,
            "batched": r.batched,
            "dedupedOf": r.deduped_of,
+           "boundedOf": r.bounded_of,
            "rung": r.rung,
            "degraded": r.degraded}
     if r.probe_placements is not None:
@@ -93,6 +103,7 @@ def _scenario_from_dict(s: dict) -> "ScenarioResult":
         fail_message=s.get("failMessage", ""),
         batched=s.get("batched", False),
         deduped_of=s.get("dedupedOf"),
+        bounded_of=s.get("boundedOf"),
         probe_placements=(list(s["probePlacements"])
                           if s.get("probePlacements") is not None else None),
         rung=s.get("rung", ""),
@@ -122,6 +133,11 @@ class SurvivabilityReport:
     # explain mode: the intact cluster's bottleneck analysis (the reference
     # every scenario row's deltaCapacity is measured against)
     baseline_bottleneck: Optional[dict] = None
+    # joint packing bounds (bounds/bracket.py): the intact baseline's
+    # capacity bracket plus how many scenario rows the bracket proved
+    # without a device solve — {"lower", "upper", "pruned"}; None when the
+    # sweep ran with bounds disabled
+    bounds: Optional[dict] = None
 
     @property
     def min_k_to_stranded(self) -> Optional[int]:
@@ -156,7 +172,12 @@ class SurvivabilityReport:
     @property
     def worst_rung(self) -> str:
         from ..runtime.degrade import worst_rung
-        return worst_rung(self.scenarios)
+        rung = worst_rung(self.scenarios)
+        if not rung and any(r.rung == RUNG_BOUNDS for r in self.scenarios):
+            # every row was proved by its capacity bracket — not a ladder
+            # rung, but the honest answer to "what served this sweep"
+            return RUNG_BOUNDS
+        return rung
 
     def to_dict(self) -> dict:
         """Stable machine-readable schema: the same {"spec", "status"}
@@ -177,6 +198,7 @@ class SurvivabilityReport:
                 "degraded": self.degraded,
                 "worstRung": self.worst_rung,
                 "baselineBottleneck": self.baseline_bottleneck,
+                "bounds": self.bounds,
                 "worstNodes": [
                     {"nodeName": nm, "headroom": h, "stranded": s}
                     for nm, h, s in self.worst_nodes()],
@@ -200,6 +222,7 @@ class SurvivabilityReport:
             batched_scenarios=status["batchedScenarios"],
             sequential_scenarios=status["sequentialScenarios"],
             baseline_bottleneck=status.get("baselineBottleneck"),
+            bounds=status.get("bounds"),
         )
 
 
@@ -340,7 +363,8 @@ def analyze(snapshot: ClusterSnapshot, scenarios: Sequence[FailureScenario],
             keep_placements: bool = False,
             journal: Optional[str] = None,
             resume: bool = False,
-            explain: bool = False) -> SurvivabilityReport:
+            explain: bool = False,
+            bounds: bool = True) -> SurvivabilityReport:
     """Run every failure scenario: drain + re-schedule displaced pods, then
     measure remaining probe headroom — batched as ONE device solve per
     problem-shape group when masking is exact, sequential per-scenario
@@ -365,6 +389,16 @@ def analyze(snapshot: ClusterSnapshot, scenarios: Sequence[FailureScenario],
     scenario's encoded problem — no extra device work) plus the remaining-
     capacity delta vs the intact baseline; the baseline analysis rides the
     report as baseline_bottleneck.
+
+    bounds=True (default) brackets every batched scenario's headroom first
+    (bounds/bracket.py, one guarded device shot) and skips the device solve
+    for any scenario the bracket already proves: a tight exact bracket
+    (lower == upper) reconstructs the headroom AND the terminal fit message
+    from the per-node caps, and a constructive lower bound at or above
+    max_limit reconstructs the limit row.  Pruned rows stamp bounded_of and
+    rung="bounds" but are otherwise row-identical to what the device solve
+    would return; bounds=False (--no-bounds) forces exact solves everywhere.
+    keep_placements disables pruning (placements need the real solve).
     """
     import os
 
@@ -377,7 +411,8 @@ def analyze(snapshot: ClusterSnapshot, scenarios: Sequence[FailureScenario],
     n = snapshot.num_nodes
 
     base_pb = enc.encode_problem(snapshot, probe, profile)
-    baseline = degrade.solve_one_guarded(base_pb, max_limit=max_limit)
+    baseline = degrade.solve_one_guarded(base_pb, max_limit=max_limit,
+                                         bounds=bounds)
 
     base_bn = None
     if explain:
@@ -476,6 +511,27 @@ def analyze(snapshot: ClusterSnapshot, scenarios: Sequence[FailureScenario],
         _registry.set_gauge(obs_names.SCENARIOS, done_count[0],
                             state="completed")
 
+    def _complete_bounded(si: int, headroom: int, msg: str, bounded_of: str,
+                          *, deg: bool,
+                          pb: Optional[enc.EncodedProblem]) -> None:
+        """A row PROVED by the bracket — no device solve ran.  Same journal
+        + gauge discipline as _complete, stamped rung="bounds"."""
+        sc, d = scenarios[si], drains[si]
+        row = ScenarioResult(
+            name=sc.name, kind=sc.kind, k=sc.k,
+            failed_nodes=[snapshot.node_names[i] for i in sc.failed],
+            displaced=d.displaced, replaced=d.replaced,
+            stranded=d.stranded, preempted=d.preempted,
+            headroom=headroom, fail_message=msg,
+            batched=True, bounded_of=bounded_of,
+            rung=RUNG_BOUNDS, degraded=deg,
+            bottleneck=_scenario_bottleneck(pb))
+        results[si] = row
+        _journal(row)
+        done_count[0] += 1
+        _registry.set_gauge(obs_names.SCENARIOS, done_count[0],
+                            state="completed")
+
     try:
         # --- drain phase (host, sequential — scenarios that lose pods) ----
         drains: Dict[int, DrainOutcome] = {}
@@ -502,6 +558,40 @@ def analyze(snapshot: ClusterSnapshot, scenarios: Sequence[FailureScenario],
             else:
                 seq_sis.append(si)
 
+        if batch_pbs and bounds and not keep_placements:
+            # --- bound-guided pruning: bracket EVERY batched scenario in
+            # one guarded device shot, then drop the ones the bracket
+            # already proves.  Only exact brackets prune (fit-only +
+            # order-independent terminal — which _mask_exact scenarios are
+            # whenever the probe has no dynamic gates), and a tight-bracket
+            # row additionally requires the host terminal diagnosis so its
+            # fail message is the one the scan would have produced.
+            from .. import bounds as bounds_mod
+            brackets, br_deg = bounds_mod.bracket_group(batch_pbs)
+            kept_pbs: List[enc.EncodedProblem] = []
+            kept_sis: List[int] = []
+            for pb_s, br, si in zip(batch_pbs, brackets, batch_sis):
+                pruned = False
+                if br.exact and max_limit > 0 and br.lower >= max_limit:
+                    _complete_bounded(
+                        si, max_limit,
+                        f"Maximum number of pods simulated: {max_limit}",
+                        "lower>=limit", deg=br_deg, pb=pb_s)
+                    pruned = True
+                elif (br.tight and br.upper < bounds_mod.UNBOUNDED):
+                    counts = bounds_mod.exhausted_fit_counts(pb_s)
+                    if counts is not None:
+                        _complete_bounded(
+                            si, br.lower,
+                            sim.format_fit_error(pb_s.snapshot.num_nodes,
+                                                 counts),
+                            "lower==upper", deg=br_deg, pb=pb_s)
+                        pruned = True
+                if not pruned:
+                    kept_pbs.append(pb_s)
+                    kept_sis.append(si)
+            batch_pbs, batch_sis = kept_pbs, kept_sis
+
         if batch_pbs:
             # one batched device solve per problem-shape group (normally one
             # group: same probe, same profile, same snapshot geometry)
@@ -513,7 +603,7 @@ def analyze(snapshot: ClusterSnapshot, scenarios: Sequence[FailureScenario],
                 try:
                     res = degrade.solve_group_guarded(
                         [batch_pbs[bi] for bi in idxs],
-                        max_limit=max_limit, mesh=mesh)
+                        max_limit=max_limit, mesh=mesh, bounds=bounds)
                 except RuntimeFault:
                     # masked problems cannot reach the oracle rung (the mask
                     # is folded into the encoding) — the analyzer's own last
@@ -536,7 +626,8 @@ def analyze(snapshot: ClusterSnapshot, scenarios: Sequence[FailureScenario],
                     snap_del = _delete_nodes(snapshot, sc.failed)
                 pb_s = enc.encode_problem(snap_del, probe, profile)
                 r = degrade.solve_one_guarded(
-                    pb_s, max_limit=max_limit, degraded=si in seq_degraded)
+                    pb_s, max_limit=max_limit, degraded=si in seq_degraded,
+                    bounds=bounds)
             _complete(si, r, was_batched=False,
                       node_names=snap_del.node_names, pb=pb_s)
     finally:
@@ -557,6 +648,13 @@ def analyze(snapshot: ClusterSnapshot, scenarios: Sequence[FailureScenario],
     # counts are derived from the rows (not running tallies) so a resumed
     # sweep reports exactly what an uninterrupted one would
     reps = [r for r in rows if r.deduped_of is None]
+    report_bounds = None
+    if bounds:
+        from .. import bounds as bounds_mod
+        bb = bounds_mod.bracket_host(base_pb)
+        report_bounds = {
+            "lower": bb.lower, "upper": bb.upper,
+            "pruned": sum(1 for r in reps if r.bounded_of is not None)}
     return SurvivabilityReport(
         probe_name=(probe.get("metadata") or {}).get("name", ""),
         num_nodes=n,
@@ -566,4 +664,5 @@ def analyze(snapshot: ClusterSnapshot, scenarios: Sequence[FailureScenario],
         batched_scenarios=sum(1 for r in reps if r.batched),
         sequential_scenarios=sum(1 for r in reps if not r.batched),
         baseline_bottleneck=base_bn,
+        bounds=report_bounds,
     )

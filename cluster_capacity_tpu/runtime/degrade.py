@@ -106,9 +106,17 @@ def _solve_oracle(pb, max_limit: int = 0, explain: bool = False):
     cap = max_limit if max_limit and max_limit > 0 \
         else sim._DEFAULT_UNLIMITED_CAP
     explain_out = {} if explain else None
+    # The failure overlay is scenario state the snapshot objects don't
+    # carry: recover it from the static codes (the alive fold runs first in
+    # encode, so a dead node is CODE_NODE_FAILED regardless of later folds)
+    # — without it an oracle-rung fallback would place onto failed nodes.
+    alive = None
+    if pb.num_alive != n:
+        from ..engine import encode as enc
+        alive = np.asarray(pb.static_code) != enc.CODE_NODE_FAILED
     placements, counts = oracle.simulate(
         pb.snapshot, pb.pod, pb.profile, max_limit=cap,
-        explain_out=explain_out)
+        explain_out=explain_out, alive_mask=alive)
     placed = len(placements)
 
     expl_obj = None
@@ -159,7 +167,7 @@ def _solve_oracle(pb, max_limit: int = 0, explain: bool = False):
 
 def solve_one_guarded(pb, max_limit: int = 0, *, deadline: float = 0.0,
                       retries: int = 0, degraded: bool = False,
-                      explain: bool = False):
+                      explain: bool = False, bounds: bool = True):
     """Hardened single-problem solve: full engine → analytic fast path →
     host oracle.  `retries` re-attempts the SAME rung before descending
     (transient device errors); `degraded` pre-marks the result when the
@@ -169,8 +177,6 @@ def solve_one_guarded(pb, max_limit: int = 0, *, deadline: float = 0.0,
     from .. import obs
 
     n = pb.snapshot.num_nodes
-    masked = pb.num_alive != n
-
     def _attempt(fn, site, phase, rung):
         last: Optional[RuntimeFault] = None
         for _ in range(retries + 1):
@@ -185,7 +191,7 @@ def solve_one_guarded(pb, max_limit: int = 0, *, deadline: float = 0.0,
     with obs.span("degrade.solve_one"):
         result, fault = _attempt(
             lambda: fast_path.solve_auto(pb, max_limit=max_limit,
-                                         explain=explain),
+                                         explain=explain, bounds=bounds),
             SITE_SOLVE, guard.PHASE_EXECUTE, RUNG_FUSED)
         if fault is None:
             return _stamp(result, RUNG_FUSED, degraded)
@@ -198,13 +204,10 @@ def solve_one_guarded(pb, max_limit: int = 0, *, deadline: float = 0.0,
         if fp_fault is None and result is not None:
             return _stamp(result, RUNG_FAST_PATH, True)
 
-        if masked:
-            # The oracle replays the snapshot and cannot see an alive_mask
-            # that was folded into the encoded problem — callers with masked
-            # problems (resilience sweeps) must fall back at a level where
-            # the mask is still expressible (deleted-snapshot sequential
-            # path).
-            raise fault
+        # _solve_oracle recovers the failure overlay from the static codes,
+        # so masked problems (resilience sweeps) keep the full ladder: the
+        # oracle replays dead nodes as infeasible, which equals deletion for
+        # the _mask_exact family — the only one that sends masks here.
         _record(fp_fault or fault, RUNG_ORACLE)
         result = guard.run(lambda: _solve_oracle(pb, max_limit=max_limit,
                                                  explain=explain),
@@ -216,7 +219,7 @@ def solve_one_guarded(pb, max_limit: int = 0, *, deadline: float = 0.0,
 def solve_group_guarded(pbs, max_limit: int = 0, mesh=None, *,
                         deadline: float = 0.0, retries: int = 0,
                         degraded: bool = False,
-                        explain: bool = False) -> List:
+                        explain: bool = False, bounds: bool = True) -> List:
     """Hardened batched group solve.  DeviceOOM splits the group in half
     geometrically (independent sub-batches, bit-identical placements) down
     to B=1; other faults — and B=1 OOM — descend to the per-item ladder."""
@@ -234,7 +237,8 @@ def solve_group_guarded(pbs, max_limit: int = 0, mesh=None, *,
                 results = guard.run(
                     lambda: sweep_mod.solve_group(pbs, max_limit=max_limit,
                                                   mesh=mesh,
-                                                  explain=explain),
+                                                  explain=explain,
+                                                  bounds=bounds),
                     site=SITE_GROUP, deadline=deadline,
                     phase=guard.PHASE_COMPILE, validate_nodes=n,
                     rung=RUNG_BATCHED, batch=len(pbs))
@@ -249,15 +253,15 @@ def solve_group_guarded(pbs, max_limit: int = 0, mesh=None, *,
             left = solve_group_guarded(pbs[:mid], max_limit=max_limit,
                                        mesh=mesh, deadline=deadline,
                                        retries=retries, degraded=True,
-                                       explain=explain)
+                                       explain=explain, bounds=bounds)
             right = solve_group_guarded(pbs[mid:], max_limit=max_limit,
                                         mesh=mesh, deadline=deadline,
                                         retries=retries, degraded=True,
-                                        explain=explain)
+                                        explain=explain, bounds=bounds)
             return left + right
 
         _record(last, RUNG_FUSED)
         return [solve_one_guarded(pb, max_limit=max_limit, deadline=deadline,
                                   retries=retries, degraded=True,
-                                  explain=explain)
+                                  explain=explain, bounds=bounds)
                 for pb in pbs]

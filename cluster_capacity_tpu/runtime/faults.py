@@ -52,8 +52,9 @@ SITE_ORACLE = "engine.oracle"
 SITE_GROUP = "parallel.solve_group"
 SITE_EXTENDERS = "engine.extenders"
 SITE_INTERLEAVE = "parallel.interleave"
+SITE_BOUNDS = "bounds.bracket"
 SITES = (SITE_SOLVE, SITE_FAST_PATH, SITE_ORACLE, SITE_GROUP,
-         SITE_EXTENDERS, SITE_INTERLEAVE)
+         SITE_EXTENDERS, SITE_INTERLEAVE, SITE_BOUNDS)
 
 
 class SimulatedHang(Exception):
@@ -211,6 +212,15 @@ def maybe_corrupt(spec: Optional[FaultSpec], result):
                 out[i] = maybe_corrupt(spec, item)
                 break
         return type(result)(out) if isinstance(result, tuple) else out
+    if not hasattr(result, "placements"):
+        # bracket-shaped outputs (bounds rung) have no placement planes to
+        # poison: invalidate the bracket / claim so the output validation in
+        # bounds/bracket.py must catch it
+        if dataclasses.is_dataclass(result) and hasattr(result, "upper"):
+            return dataclasses.replace(result, upper=-1)
+        if isinstance(result, int):
+            return -7
+        return result
     placements = list(result.placements)
     if placements:
         placements[0] = -7

@@ -253,6 +253,12 @@ def print_survivability(report, verbose: bool = False, fmt: str = "",
               f"{report.collapsed_scenarios} collapsed as symmetric "
               f"duplicates, {report.batched_scenarios} in one batched "
               f"device sweep, {report.sequential_scenarios} sequential\n")
+    bounds = getattr(report, "bounds", None)
+    if bounds:
+        out.write(f"capacity bracket [{bounds['lower']}, "
+                  f"{bounds['upper']}] on the intact cluster; "
+                  f"{bounds['pruned']} scenario(s) proved by bounds "
+                  f"without a device solve\n")
     mk = report.min_k_to_stranded
     out.write("min k to first stranded pod: "
               f"{mk if mk is not None else '-'}\n")
@@ -275,6 +281,9 @@ def print_survivability(report, verbose: bool = False, fmt: str = "",
         if verbose and r.deduped_of:
             out.write(f"{'':<{name_w}}  (metrics shared with "
                       f"{r.deduped_of})\n")
+        if verbose and getattr(r, "bounded_of", None):
+            out.write(f"{'':<{name_w}}  (proved by capacity bracket: "
+                      f"{r.bounded_of})\n")
         if verbose and r.fail_message:
             out.write(f"{'':<{name_w}}  {r.fail_message}\n")
         bn = getattr(r, "bottleneck", None)

@@ -1,0 +1,386 @@
+"""Capacity-bracket coverage (bounds/bracket.py): differential fuzz of
+``lower <= placed <= upper`` against the scan engine and the host oracle,
+tightness on fit-only shapes, pruning soundness (bounded resilience sweeps
+row-identical to unbounded), budget-clamp bit-identity, zero-recompile
+across scenario shapes, chaos degradation at the bounds fault site, the
+bracket branch of faults.maybe_corrupt, auction feasibility, and report
+round-trips of the boundedOf / bounds envelope keys."""
+
+import copy
+
+import numpy as np
+import pytest
+
+from cluster_capacity_tpu import SchedulerProfile, bounds
+from cluster_capacity_tpu.bounds import bracket as bracket_mod
+from cluster_capacity_tpu.engine import encode as enc
+from cluster_capacity_tpu.engine import simulator as sim
+from cluster_capacity_tpu.models.podspec import default_pod
+from cluster_capacity_tpu.models.snapshot import ClusterSnapshot
+from cluster_capacity_tpu.resilience import analyze, single_node_scenarios
+from cluster_capacity_tpu.runtime import degrade, faults
+from cluster_capacity_tpu.runtime.errors import NumericCorruption
+
+from helpers import build_test_node, build_test_pod
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _snapshot(n, seed=0, pods_cap=8):
+    rng = np.random.RandomState(seed)
+    nodes = []
+    for i in range(n):
+        nodes.append(build_test_node(
+            f"n{i}", int(rng.choice([1000, 2000, 3000])),
+            int(rng.choice([2, 4, 8])) * 1024 ** 3, pods_cap,
+            labels={"zone": f"z{i % 3}"}))
+    return ClusterSnapshot.from_objects(nodes)
+
+
+def _probe(cpu=300, mem=256 * 1024 ** 2, spread=None, name="probe"):
+    pod = build_test_pod(name, cpu, mem, labels={"app": name})
+    if spread is not None:
+        pod["spec"]["topologySpreadConstraints"] = [{
+            "maxSkew": spread, "topologyKey": "zone",
+            "whenUnsatisfiable": "DoNotSchedule",
+            "labelSelector": {"matchLabels": {"app": name}},
+        }]
+    return default_pod(pod)
+
+
+def _pb(snapshot, probe, profile=None, **kw):
+    return enc.encode_problem(snapshot, probe,
+                              profile or SchedulerProfile(), **kw)
+
+
+# --- differential fuzz ------------------------------------------------------
+
+def test_fuzz_bracket_vs_scan_and_oracle():
+    """Zero violations of lower <= placed <= upper over randomized shapes:
+    heterogeneous nodes, random demands, optional hard spread, random alive
+    masks — checked against both the scan engine and the host oracle, with
+    the device bracket parity-locked to the host one."""
+    rng = np.random.RandomState(42)
+    for trial in range(20):
+        n = int(rng.randint(3, 9))
+        snap = _snapshot(n, seed=trial, pods_cap=int(rng.randint(3, 10)))
+        spread = int(rng.choice([0, 0, 1, 2]))
+        probe = _probe(cpu=int(rng.choice([200, 450, 700])),
+                       mem=int(rng.choice([128, 512])) * 1024 ** 2,
+                       spread=spread or None)
+        alive = None
+        if trial % 3 == 0 and n > 3:
+            alive = np.ones(n, dtype=bool)
+            alive[int(rng.randint(n))] = False
+        pb = _pb(snap, probe, alive_mask=alive) if alive is not None \
+            else _pb(snap, probe)
+
+        host = bounds.bracket_host(pb)
+        assert 0 <= host.lower <= host.upper
+
+        placed = sim.solve(pb, bounds=False).placed_count
+        assert host.lower <= placed <= host.upper, \
+            f"trial {trial}: scan placed {placed} outside " \
+            f"[{host.lower}, {host.upper}]"
+
+        oracle = degrade._solve_oracle(pb).placed_count
+        assert host.lower <= oracle <= host.upper, \
+            f"trial {trial}: oracle placed {oracle} outside " \
+            f"[{host.lower}, {host.upper}]"
+
+        (dev,), degraded = bounds.bracket_group([pb])
+        assert not degraded
+        assert (dev.lower, dev.upper) == (host.lower, host.upper)
+
+
+def test_bracket_tight_on_fit_only():
+    """Fit-only + deterministic + full sampling: the bracket is exact and
+    equals the scan's placed count."""
+    pb = _pb(_snapshot(6, seed=3), _probe())
+    br = bounds.bracket_host(pb)
+    assert br.exact and br.tight
+    assert br.lower == br.upper == sim.solve(pb, bounds=False).placed_count
+
+
+def test_spread_bracket_sound_not_constructive():
+    """A hard spread constraint keeps the upper bound valid but zeroes the
+    constructive lower (placement order matters under a dynamic gate)."""
+    pb = _pb(_snapshot(9, seed=5), _probe(spread=1))
+    br = bounds.bracket_host(pb)
+    assert br.lower == 0 and not br.exact
+    placed = sim.solve(pb, bounds=False).placed_count
+    assert placed <= br.upper < bounds.UNBOUNDED
+
+
+def test_bracket_sentinels():
+    """Fit filter off -> no finite bound; pod-level rejection -> [0, 0]."""
+    profile = SchedulerProfile()
+    profile.filters = [f for f in profile.filters
+                       if f != "NodeResourcesFit"]
+    br = bounds.bracket_host(_pb(_snapshot(4), _probe(), profile=profile))
+    assert (br.lower, br.upper) == (0, bounds.UNBOUNDED)
+    assert br.method == "no_fit"
+
+
+def test_oracle_respects_alive_mask():
+    """Regression (found by the bracket fuzz): the host oracle used to
+    ignore the resilience failure overlay and place onto dead nodes."""
+    snap = _snapshot(5, seed=4)
+    alive = np.array([True, True, False, True, True])
+    pb = _pb(snap, _probe(), alive_mask=alive)
+    res = degrade._solve_oracle(pb)
+    assert 2 not in res.placements
+    assert res.placed_count == sim.solve(pb, bounds=False).placed_count
+    assert res.fail_counts.get(enc.STATIC_REASONS[enc.CODE_NODE_FAILED]) == 1
+
+
+# --- budget clamps ----------------------------------------------------------
+
+def test_budget_clamp_bit_identity():
+    """The upper-bound budget clamp must never change results: bounded and
+    unbounded scan solves place identically, spread active."""
+    pb = _pb(_snapshot(9, seed=7), _probe(spread=2))
+    a = sim.solve(pb, bounds=True)
+    b = sim.solve(pb, bounds=False)
+    assert a.placed_count == b.placed_count
+    assert a.placements == b.placements
+    assert a.fail_message == b.fail_message
+
+
+def test_upper_bound_host_caps_budget():
+    pb = _pb(_snapshot(5, seed=1), _probe())
+    up = bounds.upper_bound_host(pb)
+    assert 0 < up < bounds.UNBOUNDED
+    assert up == bounds.bracket_host(pb).upper
+
+
+# --- pruning soundness ------------------------------------------------------
+
+def _rows(report):
+    return [(r.name, r.displaced, r.replaced, r.stranded, r.preempted,
+             r.headroom, r.fail_message) for r in report.scenarios]
+
+
+def test_pruned_sweep_row_identical():
+    snap = _snapshot(8, seed=11)
+    scenarios = single_node_scenarios(snap)
+    probe = _probe()
+    bounded = analyze(snap, scenarios, probe, dedup=False, bounds=True)
+    unbounded = analyze(snap, scenarios, probe, dedup=False, bounds=False)
+    assert _rows(bounded) == _rows(unbounded)
+    pruned = [r for r in bounded.scenarios if r.bounded_of is not None]
+    assert pruned, "no scenario was proved by its bracket"
+    for r in pruned:
+        assert r.rung == "bounds" and r.bounded_of == "lower==upper"
+    assert bounded.bounds is not None
+    assert set(bounded.bounds) == {"lower", "upper", "pruned"}
+    assert bounded.bounds["pruned"] == len(pruned)
+    assert unbounded.bounds is None
+
+
+def test_pruned_sweep_respects_max_limit():
+    snap = _snapshot(8, seed=11)
+    scenarios = single_node_scenarios(snap)
+    probe = _probe()
+    bounded = analyze(snap, scenarios, probe, max_limit=2, dedup=False,
+                      bounds=True)
+    unbounded = analyze(snap, scenarios, probe, max_limit=2, dedup=False,
+                        bounds=False)
+    assert _rows(bounded) == _rows(unbounded)
+    limited = [r for r in bounded.scenarios
+               if r.bounded_of == "lower>=limit"]
+    assert limited
+    for r in limited:
+        assert r.headroom == 2
+        assert r.fail_message == "Maximum number of pods simulated: 2"
+
+
+def test_keep_placements_disables_pruning():
+    """Pruning would drop the placement trace the caller asked for, so
+    keep_placements wins over bounds."""
+    snap = _snapshot(6, seed=2)
+    rep = analyze(snap, single_node_scenarios(snap), _probe(), dedup=False,
+                  bounds=True, keep_placements=True)
+    assert all(r.bounded_of is None for r in rep.scenarios)
+    assert all(r.probe_placements is not None for r in rep.scenarios)
+
+
+def test_pruned_sweep_with_dedup():
+    """Dedup and bounds compose: the bounded deduped sweep matches the
+    unbounded undeduped one row-for-row."""
+    snap = ClusterSnapshot.from_objects(
+        [build_test_node(f"n{i}", 2000, 4 * 1024 ** 3, 8) for i in range(6)])
+    scenarios = single_node_scenarios(snap)
+    a = analyze(snap, scenarios, _probe(), dedup=True, bounds=True)
+    b = analyze(snap, scenarios, _probe(), dedup=False, bounds=False)
+    assert _rows(a) == _rows(b)
+
+
+def test_exhausted_fit_counts_matches_scan_message():
+    pb = _pb(_snapshot(7, seed=9), _probe())
+    counts = bounds.exhausted_fit_counts(pb)
+    assert counts is not None
+    msg = sim.format_fit_error(pb.snapshot.num_nodes, counts)
+    res = sim.solve(pb, bounds=False)
+    assert res.fail_message == msg
+
+
+# --- compile behavior -------------------------------------------------------
+
+def test_zero_recompile_across_scenario_shapes():
+    """Different scenarios of one sweep (same axes, different alive masks /
+    values) must reuse one compiled bracket kernel."""
+    from cluster_capacity_tpu import obs
+    from cluster_capacity_tpu.utils.metrics import default_registry
+
+    snap = _snapshot(6, seed=4)
+    probe = _probe()
+
+    def group(dead):
+        pbs = []
+        for d in dead:
+            alive = np.ones(snap.num_nodes, dtype=bool)
+            alive[d] = False
+            pbs.append(_pb(snap, probe, alive_mask=alive))
+        return pbs
+
+    obs.install_recompile_hook()
+    bounds.bracket_group(group([0, 1, 2]))          # warm the kernel
+    before = default_registry.counter_total(obs.names.RECOMPILES)
+    brs, degraded = bounds.bracket_group(group([3, 4, 5]))
+    after = default_registry.counter_total(obs.names.RECOMPILES)
+    assert after == before, "bracket kernel recompiled on a same-shape group"
+    assert not degraded and len(brs) == 3
+
+
+# --- chaos / fault plumbing -------------------------------------------------
+
+def test_chaos_corrupt_degrades_to_host():
+    pb = _pb(_snapshot(6, seed=6), _probe())
+    clean, _ = bounds.bracket_group([pb])
+    faults.install_text(["bounds.bracket:corrupt"])
+    (br,), degraded = bounds.bracket_group([pb])
+    assert degraded
+    assert (br.lower, br.upper) == (clean[0].lower, clean[0].upper)
+
+
+def test_chaos_oom_degrades_to_host():
+    pb = _pb(_snapshot(6, seed=6), _probe())
+    clean, _ = bounds.bracket_group([pb])
+    faults.install_text(["bounds.bracket:oom"])
+    (br,), degraded = bounds.bracket_group([pb])
+    assert degraded
+    assert (br.lower, br.upper) == (clean[0].lower, clean[0].upper)
+
+
+def test_chaos_sweep_rows_survive_bounds_fault():
+    """A fault at the bounds site must not change sweep rows — brackets
+    degrade to the host recomputation and pruning stays sound."""
+    snap = _snapshot(6, seed=8)
+    scenarios = single_node_scenarios(snap)
+    clean = analyze(snap, scenarios, _probe(), dedup=False, bounds=True)
+    faults.install_text(["bounds.bracket:corrupt"])
+    hurt = analyze(snap, scenarios, _probe(), dedup=False, bounds=True)
+    assert _rows(hurt) == _rows(clean)
+    assert any(r.degraded for r in hurt.scenarios if r.bounded_of)
+
+
+def test_maybe_corrupt_bracket_shapes():
+    """The corrupt fault shaper poisons bracket-shaped outputs (no
+    placement planes) so _validate_brackets must catch them."""
+    spec = faults.parse_spec("bounds.bracket:corrupt")
+    br = bracket_mod.CapacityBracket(3, 5, exact=True)
+    bad = faults.maybe_corrupt(spec, br)
+    assert bad.upper == -1
+    with pytest.raises(NumericCorruption):
+        bracket_mod._validate_brackets([bad], site=faults.SITE_BOUNDS)
+    assert faults.maybe_corrupt(spec, 7) == -7
+
+
+def test_validate_brackets_rejects_invalid():
+    ok = bracket_mod.CapacityBracket(1, 2, exact=False)
+    bracket_mod._validate_brackets([ok], site="t")
+    for bad in (bracket_mod.CapacityBracket(-1, 2, exact=False),
+                bracket_mod.CapacityBracket(5, 2, exact=False),
+                bracket_mod.CapacityBracket(0, bounds.UNBOUNDED + 1,
+                                            exact=False)):
+        with pytest.raises(NumericCorruption):
+            bracket_mod._validate_brackets([bad], site="t")
+
+
+# --- auction (template mixes) ----------------------------------------------
+
+def test_mix_single_template_equals_solo():
+    pb = _pb(_snapshot(6, seed=12), _probe())
+    solo = bounds.bracket_host(pb)
+    joint, claims, degraded = bounds.bracket_mix([pb])
+    assert not degraded
+    assert claims == [solo.lower]
+    assert joint.lower == joint.upper == solo.upper
+    assert joint.exact
+
+
+def test_mix_claims_feasible_and_bracketed():
+    snap = _snapshot(6, seed=13)
+    pbs = [_pb(snap, _probe(cpu=300, name="a")),
+           _pb(snap, _probe(cpu=700, name="b"))]
+    joint, claims, degraded = bounds.bracket_mix(pbs)
+    assert not degraded
+    assert all(c >= 0 for c in claims)
+    assert joint.lower <= joint.upper
+    assert sum(claims) >= joint.lower
+    # each claim alone cannot beat that template's solo upper bound
+    for c, pb in zip(claims, pbs):
+        assert c <= bounds.bracket_host(pb).upper
+    # the auction's claims are jointly feasible: replay them against the
+    # shared free matrix on the host and demand nothing goes negative
+    free, pods_free, reqs, gates = (
+        a.astype(np.float64) if a.dtype != bool else a
+        for a in bracket_mod._mix_arrays(pbs))
+    host_claims = bracket_mod._auction_host(pbs)
+    assert claims == host_claims
+
+
+# --- report / journal round-trip -------------------------------------------
+
+def test_report_roundtrip_preserves_bounds():
+    from cluster_capacity_tpu.resilience.analyzer import SurvivabilityReport
+
+    snap = _snapshot(6, seed=14)
+    rep = analyze(snap, single_node_scenarios(snap), _probe(), dedup=False,
+                  bounds=True)
+    assert any(r.bounded_of for r in rep.scenarios)
+    doc = rep.to_dict()
+    back = SurvivabilityReport.from_dict(doc)
+    assert back.bounds == rep.bounds
+    assert [r.bounded_of for r in back.scenarios] \
+        == [r.bounded_of for r in rep.scenarios]
+    assert _rows(back) == _rows(rep)
+
+
+def test_cli_no_bounds_flag(tmp_path, capsys):
+    import json as json_mod
+
+    from cluster_capacity_tpu.cli import resilience as cli
+
+    snap_doc = {"nodes": [build_test_node(f"n{i}", 2000, 4 * 1024 ** 3, 8)
+                          for i in range(4)], "pods": []}
+    path = tmp_path / "snap.json"
+    path.write_text(json_mod.dumps(snap_doc))
+
+    assert cli.run(["--snapshot", str(path), "--nodes", "-o", "json"]) == 0
+    with_bounds = json_mod.loads(capsys.readouterr().out)
+    assert cli.run(["--snapshot", str(path), "--nodes", "--no-bounds",
+                    "-o", "json"]) == 0
+    without = json_mod.loads(capsys.readouterr().out)
+
+    key = lambda d: [(s["name"], s["headroom"], s.get("failMessage", ""))
+                     for s in d["status"]["scenarios"]]
+    assert key(with_bounds) == key(without)
+    assert with_bounds["status"].get("bounds") is not None
+    assert without["status"].get("bounds") is None

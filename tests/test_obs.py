@@ -358,7 +358,9 @@ def test_resilience_cli_dumps_metrics_and_trace(tmp_path):
         pytest.skip("example snapshot not present")
     mpath = str(tmp_path / "metrics.prom")
     tpath = str(tmp_path / "trace.jsonl")
-    rc = run(["--snapshot", snap, "--nodes", "-o", "json",
+    # bounds off: the drill needs the batched group solve to actually
+    # dispatch (and OOM), which the capacity brackets would prove away
+    rc = run(["--snapshot", snap, "--nodes", "-o", "json", "--no-bounds",
               "--inject-fault", "parallel.solve_group:oom:1:99",
               "--metrics-dump", mpath, "--trace-out", tpath])
     assert rc == 0

@@ -247,12 +247,18 @@ def test_retries_reattempt_same_rung():
     _same(res, healthy)
 
 
-def test_masked_problem_cannot_reach_oracle():
+def test_masked_problem_reaches_oracle():
+    """The oracle recovers the failure overlay from the static codes, so a
+    masked resilience problem keeps the full ladder — and the oracle rung
+    must never place onto a dead node."""
     alive = np.array([True, False, True, True])
     pb = _pb(alive_mask=alive)
+    healthy = _healthy_reference(pb)
     with faults.inject("engine.solve:oom:1:0", "engine.fast_path:oom:1:0"):
-        with pytest.raises(RuntimeFault):
-            degrade.solve_one_guarded(pb)
+        res = degrade.solve_one_guarded(pb)
+    assert res.rung == degrade.RUNG_ORACLE and res.degraded
+    assert 1 not in res.placements
+    _same(res, healthy)
 
 
 def test_degradation_records_events():
@@ -604,11 +610,13 @@ def test_interrupted_sweep_journals_finished_prefix(tmp_path):
 
 
 def test_degraded_sweep_bit_identical_and_flagged():
+    # bounds off: this drill exercises the group-solve ladder, which the
+    # capacity brackets would otherwise prove away without a dispatch
     snap = _sweep_snapshot()
-    healthy = _analyze(snap)
+    healthy = _analyze(snap, bounds=False)
     assert not healthy.degraded
     with faults.inject("parallel.solve_group:oom"):
-        hurt = _analyze(snap)
+        hurt = _analyze(snap, bounds=False)
     assert hurt.degraded
     assert hurt.worst_rung in degrade.LADDER
     assert [r.headroom for r in hurt.scenarios] == \
@@ -685,7 +693,10 @@ def test_resilience_cli_journal_resume_and_strict(tmp_path, capsys):
     assert res.run(["--snapshot", snap, "--resume"]) == 1  # needs --journal
     capsys.readouterr()
 
+    # --no-bounds: the injected fault sits at the group-solve site, which a
+    # bracket-pruned sweep would never dispatch
     rc = res.run(["--snapshot", snap, "--podspec", pod, "--journal", journal,
+                  "--no-bounds",
                   "--inject-fault", "parallel.solve_group:oom", "--strict"])
     out = capsys.readouterr()
     assert rc == 3

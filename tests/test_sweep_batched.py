@@ -91,9 +91,9 @@ def test_topology_templates_batch_and_match(monkeypatch):
     batch_calls = []
     orig = sweep_mod._batched_solve
 
-    def counting(pbs, max_limit, mesh=None, explain=False):
+    def counting(pbs, max_limit, mesh=None, explain=False, bounds=True):
         batch_calls.append(len(pbs))
-        return orig(pbs, max_limit, mesh=mesh, explain=explain)
+        return orig(pbs, max_limit, mesh=mesh, explain=explain, bounds=bounds)
 
     monkeypatch.setattr(sweep_mod, "_batched_solve", counting)
     results = sweep_mod.sweep(snap, templates, profile=profile, max_limit=40)

@@ -207,6 +207,45 @@ def _drive_oracle():
     return driver
 
 
+def _spread_problem(n: int):
+    """EncodedProblem whose probe carries a hard topology-spread constraint,
+    so the bracket kernel lowers its fold plane (num_constraints > 0)."""
+    from cluster_capacity_tpu.engine import encode as enc
+    from cluster_capacity_tpu.models.podspec import default_pod
+    from cluster_capacity_tpu.models.snapshot import ClusterSnapshot
+    from cluster_capacity_tpu.utils.config import SchedulerProfile
+
+    snapshot = ClusterSnapshot.from_objects(_nodes(n), [])
+    pod = _pod("probe", 300, int(5e7), labels={"app": "probe"})
+    pod["spec"]["topologySpreadConstraints"] = [{
+        "maxSkew": 1, "topologyKey": "zone",
+        "whenUnsatisfiable": "DoNotSchedule",
+        "labelSelector": {"matchLabels": {"app": "probe"}},
+    }]
+    return enc.encode_problem(snapshot, default_pod(pod), SchedulerProfile())
+
+
+def _drive_bounds_bracket(b: int):
+    def driver():
+        from cluster_capacity_tpu import bounds
+        bounds.bracket_group([_problem(8) for _ in range(b)])
+    return driver
+
+
+def _drive_bounds_spread():
+    def driver():
+        from cluster_capacity_tpu import bounds
+        bounds.bracket_group([_spread_problem(8)])
+    return driver
+
+
+def _drive_bounds_auction():
+    def driver():
+        from cluster_capacity_tpu import bounds
+        bounds.bracket_mix([_problem(8), _problem(8, milli_cpu=500)])
+    return driver
+
+
 def canonical_entries() -> List[EntrySpec]:
     """The committed ladder; budget keys are derived from these names."""
     fused_on = {"CC_TPU_FUSED": "1"}
@@ -226,6 +265,14 @@ def canonical_entries() -> List[EntrySpec]:
                   env=fused_off),
         EntrySpec("oracle/n4", "oracle", _drive_oracle(), env=fused_off,
                   expect_no_dispatch=True),
+        # capacity-bracket kernels (bounds/bracket.py): the batched frac/floor
+        # bracket, its spread-fold variant, and the FFD auction lower bound
+        EntrySpec("bounds_bracket/n8b3", "bounds",
+                  _drive_bounds_bracket(3), env=fused_off),
+        EntrySpec("bounds_bracket_spread/n8", "bounds",
+                  _drive_bounds_spread(), env=fused_off),
+        EntrySpec("bounds_auction/n8t2", "bounds",
+                  _drive_bounds_auction(), env=fused_off),
     ]
 
 

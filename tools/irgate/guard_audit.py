@@ -41,6 +41,8 @@ DISPATCH_SET: Set[Tuple[str, str]] = {
     ("parallel.sweep", "_batched_solve"),
     ("parallel.distributed", "solve_on_mesh"),
     ("parallel.interleave", "solve_interleaved_tensor"),
+    ("bounds.bracket", "bracket_device"),
+    ("bounds.bracket", "auction_device"),
 }
 
 DISPATCH_MODULES = {m for m, _ in DISPATCH_SET}

@@ -25,6 +25,9 @@ HOT_DIR_PREFIXES = (
     # attribution is computed inside the jitted solves; the host-side
     # artifact/bottleneck modules must stay dispatch-free aggregation code
     "cluster_capacity_tpu/explain/",
+    # capacity-bracket kernels run before every pruned sweep: a stray sync
+    # there would serialize the one batched shot pruning is supposed to be
+    "cluster_capacity_tpu/bounds/",
 )
 
 # Function qualnames allowed to synchronize with the device.  A sync call
@@ -56,6 +59,9 @@ SYNC_QUALNAMES = {
     "_drain",
     # resilience/analyzer.py: scenario driver — drains between device solves
     "analyze",
+    # bounds/bracket.py: the bracket/auction kernels' single readback points
+    "bracket_device",
+    "auction_device",
 }
 
 # Default baseline location, relative to the repo root.

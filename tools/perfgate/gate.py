@@ -51,6 +51,7 @@ _SCENARIO_PREFIXES = (
     ("c5_", "c5"),
     ("interleave_", "interleave"),
     ("resilience_", "resilience"),
+    ("bounds_", "bounds"),
 )
 
 
@@ -98,6 +99,12 @@ def gated_metrics(bench: Dict[str, Any]) -> Dict[str, float]:
             continue
         if k.endswith("_per_sec"):
             out[k] = float(v)
+    # the pruned fraction is a coverage floor, not a throughput: the bracket
+    # silently losing exactness would un-prune the sweep while the pps key
+    # still exists, so it is gated by name despite not being *_per_sec
+    pf = bench.get("bounds_sweep_pruned_fraction")
+    if isinstance(pf, (int, float)) and not isinstance(pf, bool):
+        out["bounds_sweep_pruned_fraction"] = float(pf)
     return out
 
 
