@@ -7,7 +7,7 @@ CXX ?= g++
 CXXFLAGS ?= -O2 -std=c++17 -fPIC -Wall
 NATIVE_LIB := cluster_capacity_tpu/models/libccsnap.so
 
-.PHONY: all build native lint test-unit test-parity test-fuzz test-dist test-integration test-e2e bench perfgate trend chaos clean verify-native ci
+.PHONY: all build native lint test-unit test-parity test-fuzz test-dist test-integration test-e2e bench perfgate trend chaos profile-smoke clean verify-native ci
 
 all: build
 
@@ -82,6 +82,14 @@ perfgate:
 # between consecutive rounds.
 trend:
 	$(PY) -m tools.trend
+
+# Deep-profiling smoke: `hypercc profile` in-process on a tiny cluster;
+# asserts the attribution/calibration artifact schemas and that an
+# injected fault yields a loadable flight-recorder bundle whose repro
+# line carries the injection spec (obs/profile.py, obs/costmodel.py,
+# obs/flight.py).
+profile-smoke:
+	JAX_PLATFORMS=cpu $(PY) tools/profile_smoke.py
 
 # Full CI pipeline: lint + native + default suite + fuzz slice +
 # integration + multichip dryrun, as configured in ci.yaml (the

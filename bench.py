@@ -701,14 +701,22 @@ def main() -> None:
         # execute (BASELINE.md round-5 findings; perfgate excludes compile
         # by construction — pps is measured after warmup).
         from cluster_capacity_tpu import obs
+        from cluster_capacity_tpu.obs import profile as obs_profile
         from cluster_capacity_tpu.utils.metrics import default_registry
         obs.install_recompile_hook()
+        obs_profile.enable_memory_sampling()
         out = _SCENARIOS[scenario]()
         out["platform"] = _child_platform()
         out["recompiles"] = int(
             default_registry.counter_total(obs.names.RECOMPILES))
         out["backend_compile_s"] = round(
             default_registry.counter_total(obs.names.COMPILE_SECONDS), 3)
+        # Guarded-dispatch device attribution (obs/profile.py): lets the
+        # trend check name the phase a regression lives in — compile vs
+        # execute vs host — instead of just "pps fell".
+        dev = obs_profile.device_summary()
+        if dev.get("device_s") or dev.get("sites"):
+            out["device"] = dev
         print(json.dumps(out))
         return
 
@@ -807,6 +815,8 @@ def main() -> None:
             continue
         ph = {k: d[k] for k in ("warmup_s", "steady_s", "steady_reps_s",
                                 "recompiles", "backend_compile_s") if k in d}
+        if isinstance(d.get("device"), dict):
+            ph["device"] = d["device"]
         if ph:
             phases[name] = ph
     if phases:

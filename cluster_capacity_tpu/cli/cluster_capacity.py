@@ -93,6 +93,22 @@ def build_parser(prog: str = "cluster-capacity") -> argparse.ArgumentParser:
                    help="Write collected telemetry spans as Chrome-trace-"
                         "event JSONL (loadable in Perfetto / chrome://"
                         "tracing) to FILE after the run ('-' = stdout).")
+    p.add_argument("--profile-out", dest="profile_out", default="",
+                   metavar="DIR",
+                   help="Deep profiling: run the analysis under programmatic "
+                        "jax.profiler capture writing the profiler trace to "
+                        "DIR, sample device memory watermarks per dispatch, "
+                        "and write the site×rung×phase device-time "
+                        "attribution table to DIR/attribution.json "
+                        "(obs/profile.py).")
+    p.add_argument("--flight-dir", dest="flight_dir", default="",
+                   metavar="DIR",
+                   help="Arm the fault flight recorder: any RuntimeFault "
+                        "crossing the dispatch guard — or a --strict "
+                        "failure — dumps a self-contained triage bundle "
+                        "(spans, metrics, events, fault + injection specs, "
+                        "jaxpr, one-line repro) under DIR (obs/flight.py; "
+                        "bounded, oldest bundles pruned).")
     p.add_argument("--period", type=float, default=0.0,
                    help="Continuous mode: re-sync and re-run the analysis "
                         "every PERIOD seconds (the reference's historical "
@@ -159,6 +175,7 @@ def _load_live_cluster(kubeconfig: str):
 
 
 def run(argv: Optional[List[str]] = None, prog: str = "cluster-capacity") -> int:
+    argv = list(sys.argv[1:]) if argv is None else list(argv)
     args = build_parser(prog).parse_args(argv)
 
     # Validation mirrors app/server.go:83-100.
@@ -182,6 +199,10 @@ def run(argv: Optional[List[str]] = None, prog: str = "cluster-capacity") -> int
         except ValueError as e:
             print(f"Error: {e}", file=sys.stderr)
             return 1
+
+    if args.flight_dir:
+        from ..obs import flight
+        flight.install(args.flight_dir, argv=prog.split() + argv)
 
     pods = []
     for spec_path in args.podspec:
@@ -329,39 +350,66 @@ def run(argv: Optional[List[str]] = None, prog: str = "cluster-capacity") -> int
         reg.observe(metrics_mod.SCHEDULING_DURATION, time.perf_counter() - t0)
         return build_review(pods, results)
 
+    def _dump_telemetry(final: bool) -> None:
+        """Telemetry dump: atomically (temp + rename) for file targets so a
+        scraper can read mid-watch; '-' targets only dump at exit."""
+        from .. import obs
+        if args.metrics_dump and (final or args.metrics_dump != "-"):
+            obs.write_metrics(args.metrics_dump,
+                              atomic=args.metrics_dump != "-")
+        if args.trace_out and (final or args.trace_out != "-"):
+            n = obs.write_trace(args.trace_out,
+                                atomic=args.trace_out != "-")
+            if final and args.trace_out != "-":
+                print(f"trace: {n} span(s) written to {args.trace_out}",
+                      file=sys.stderr)
+
+    import contextlib
     import time
     if args.watch and args.period <= 0:
         args.period = 10.0
     runs = 0
     any_degraded = False
-    while True:
-        review = one_run()
-        print_review(review, verbose=args.verbose, fmt=args.output)
-        any_degraded = any_degraded or review.degraded
-        if args.metrics:
-            from ..utils.metrics import default_registry
-            sys.stderr.write(default_registry.render())
-        runs += 1
-        if args.strict and any_degraded:
-            # --strict must not wait for a watch loop that may never exit:
-            # the first degraded run ends the loop and returns status 3
-            break
-        if args.period <= 0:
-            break
-        if args.period_iterations and runs >= args.period_iterations:
-            break
-        sys.stdout.flush()
-        time.sleep(args.period)
+    with contextlib.ExitStack() as stack:
+        if args.profile_out:
+            from ..obs import profile as obs_profile
+            stack.enter_context(obs_profile.capture(args.profile_out))
+        while True:
+            review = one_run()
+            if args.flight_dir:
+                from ..obs import flight
+                review.flight_bundles = flight.bundle_paths()
+            print_review(review, verbose=args.verbose, fmt=args.output)
+            any_degraded = any_degraded or review.degraded
+            if args.metrics:
+                from ..utils.metrics import default_registry
+                sys.stderr.write(default_registry.render())
+            runs += 1
+            if args.strict and any_degraded:
+                # --strict must not wait for a watch loop that may never
+                # exit: the first degraded run ends the loop, returns 3
+                break
+            if args.period <= 0:
+                break
+            # continuous mode: rewrite telemetry every iteration so a
+            # long-running watch is scrapeable mid-flight
+            _dump_telemetry(final=False)
+            if args.period_iterations and runs >= args.period_iterations:
+                break
+            sys.stdout.flush()
+            time.sleep(args.period)
     if args.metrics_dump or args.trace_out:
-        from .. import obs
-        if args.metrics_dump:
-            obs.write_metrics(args.metrics_dump)
-        if args.trace_out:
-            n = obs.write_trace(args.trace_out)
-            if args.trace_out != "-":
-                print(f"trace: {n} span(s) written to {args.trace_out}",
-                      file=sys.stderr)
+        _dump_telemetry(final=True)
+    if args.profile_out:
+        from ..obs import profile as obs_profile
+        out_path = os.path.join(args.profile_out, "attribution.json")
+        obs_profile.write_attribution(out_path)
+        print(f"profile: attribution written to {out_path}", file=sys.stderr)
     if args.strict and any_degraded:
+        if args.flight_dir:
+            from ..obs import flight
+            flight.on_strict(f"--strict: solve served by degraded ladder "
+                             f"rung {review.rung or '?'}")
         print("Error: --strict and at least one solve was served by a "
               "degraded ladder rung", file=sys.stderr)
         return 3

@@ -15,6 +15,7 @@ from typing import List, Optional
 from . import cluster_capacity as cc_cli
 from . import explain as explain_cli
 from . import genpod as genpod_cli
+from . import profile as profile_cli
 from . import resilience as resilience_cli
 
 _COMMANDS = {
@@ -22,6 +23,7 @@ _COMMANDS = {
     "genpod": genpod_cli.run,
     "resilience": resilience_cli.run,
     "explain": explain_cli.run,
+    "profile": profile_cli.run,
 }
 
 
@@ -43,7 +45,9 @@ def run(argv: Optional[List[str]] = None) -> int:
           "  genpod             generate a pod spec from namespace limits\n"
           "  resilience         N-k failure sweeps with drain re-scheduling\n"
           "  explain            why-not / why-here / bottleneck attribution "
-          "for one solve\n",
+          "for one solve\n"
+          "  profile            device-time/memory attribution + cost-model "
+          "calibration under capture\n",
           file=sys.stderr)
     return 0 if argv and argv[0] in ("-h", "--help") else 1
 

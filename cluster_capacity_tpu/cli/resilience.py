@@ -11,6 +11,7 @@ json, or yaml form.
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 from typing import List, Optional
 
@@ -116,10 +117,24 @@ def build_parser(prog: str = "resilience") -> argparse.ArgumentParser:
                         "event JSONL (Perfetto-loadable; a fault-injected "
                         "sweep shows its degradation path rung-by-rung) to "
                         "FILE after the sweep ('-' = stdout).")
+    p.add_argument("--profile-out", dest="profile_out", default="",
+                   metavar="DIR",
+                   help="Deep profiling: run the sweep under programmatic "
+                        "jax.profiler capture writing to DIR, sample device "
+                        "memory watermarks per dispatch, and write the "
+                        "site×rung×phase attribution table to "
+                        "DIR/attribution.json (obs/profile.py).")
+    p.add_argument("--flight-dir", dest="flight_dir", default="",
+                   metavar="DIR",
+                   help="Arm the fault flight recorder: any RuntimeFault "
+                        "crossing the dispatch guard — or a --strict "
+                        "failure — dumps a self-contained triage bundle "
+                        "under DIR (obs/flight.py; bounded).")
     return p
 
 
 def run(argv: Optional[List[str]] = None, prog: str = "resilience") -> int:
+    argv = list(sys.argv[1:]) if argv is None else list(argv)
     args = build_parser(prog).parse_args(argv)
 
     if not args.snapshot:
@@ -151,6 +166,10 @@ def run(argv: Optional[List[str]] = None, prog: str = "resilience") -> int:
         # Count backend compiles while telemetry output was asked for.
         from .. import obs
         obs.install_recompile_hook()
+
+    if args.flight_dir:
+        from ..obs import flight
+        flight.install(args.flight_dir, argv=prog.split() + argv)
 
     if args.podspec:
         probe = default_pod(parse_pod_text(_read_podspec(args.podspec)))
@@ -201,11 +220,17 @@ def run(argv: Optional[List[str]] = None, prog: str = "resilience") -> int:
         return 1
 
     from ..runtime.errors import CheckpointCorruption
+    import contextlib
     try:
-        report = analyze(snapshot, scenarios, probe, profile=profile,
-                         max_limit=args.max_limit, dedup=not args.no_dedup,
-                         journal=args.journal or None, resume=args.resume,
-                         explain=args.explain, bounds=not args.no_bounds)
+        with contextlib.ExitStack() as stack:
+            if args.profile_out:
+                from ..obs import profile as obs_profile
+                stack.enter_context(obs_profile.capture(args.profile_out))
+            report = analyze(snapshot, scenarios, probe, profile=profile,
+                             max_limit=args.max_limit,
+                             dedup=not args.no_dedup,
+                             journal=args.journal or None, resume=args.resume,
+                             explain=args.explain, bounds=not args.no_bounds)
     except CheckpointCorruption as e:
         print(f"Error: {e}", file=sys.stderr)
         return 1
@@ -213,13 +238,23 @@ def run(argv: Optional[List[str]] = None, prog: str = "resilience") -> int:
     if args.metrics_dump or args.trace_out:
         from .. import obs
         if args.metrics_dump:
-            obs.write_metrics(args.metrics_dump)
+            obs.write_metrics(args.metrics_dump, atomic=True)
         if args.trace_out:
-            n = obs.write_trace(args.trace_out)
+            n = obs.write_trace(args.trace_out,
+                                atomic=args.trace_out != "-")
             if args.trace_out != "-":
                 print(f"trace: {n} span(s) written to {args.trace_out}",
                       file=sys.stderr)
+    if args.profile_out:
+        from ..obs import profile as obs_profile
+        out_path = os.path.join(args.profile_out, "attribution.json")
+        obs_profile.write_attribution(out_path)
+        print(f"profile: attribution written to {out_path}", file=sys.stderr)
     if args.strict and report.degraded:
+        if args.flight_dir:
+            from ..obs import flight
+            flight.on_strict(f"--strict: scenario served by degraded "
+                             f"ladder rung {report.worst_rung or '?'}")
         print("Error: --strict and at least one scenario was served by a "
               "degraded ladder rung", file=sys.stderr)
         return 3

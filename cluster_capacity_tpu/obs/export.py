@@ -12,6 +12,7 @@ fault-injected sweep reads rung-by-rung off the track.
 from __future__ import annotations
 
 import json
+import os
 import sys
 from typing import List, Optional
 
@@ -51,25 +52,37 @@ def trace_events(span_list: Optional[List[spans_mod.Span]] = None) -> list:
 
 
 def write_trace(path: str,
-                span_list: Optional[List[spans_mod.Span]] = None) -> int:
-    """Write spans as trace-event JSONL; returns the event count."""
+                span_list: Optional[List[spans_mod.Span]] = None, *,
+                atomic: bool = False) -> int:
+    """Write spans as trace-event JSONL; returns the event count.
+
+    ``atomic=True`` writes to a temp file and renames, so a scraper reading
+    the path mid-write (a --period watch loop rewriting every iteration)
+    never sees a torn file."""
     events = trace_events(span_list)
-    out = sys.stdout if path == "-" else open(path, "w")
-    try:
+    if path == "-":
+        for ev in events:
+            sys.stdout.write(json.dumps(ev) + "\n")
+        return len(events)
+    target = path + ".tmp" if atomic else path
+    with open(target, "w") as out:
         for ev in events:
             out.write(json.dumps(ev) + "\n")
-    finally:
-        if out is not sys.stdout:
-            out.close()
+    if atomic:
+        os.replace(target, path)
     return len(events)
 
 
-def write_metrics(path: str, registry=None) -> None:
-    """Dump a registry in Prometheus text exposition format ("-" = stdout)."""
+def write_metrics(path: str, registry=None, *, atomic: bool = False) -> None:
+    """Dump a registry in Prometheus text exposition format ("-" = stdout).
+    ``atomic=True`` rewrites via temp + rename (scrape-safe mid-run)."""
     registry = registry or metrics_mod.default_registry
     text = registry.render()
     if path == "-":
         sys.stdout.write(text)
         return
-    with open(path, "w") as f:
+    target = path + ".tmp" if atomic else path
+    with open(target, "w") as f:
         f.write(text)
+    if atomic:
+        os.replace(target, path)

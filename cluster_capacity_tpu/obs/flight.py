@@ -1,0 +1,358 @@
+"""Bounded flight recorder: self-contained triage bundles on fault.
+
+When installed (``--flight-dir`` on either CLI, or programmatically), every
+classified ``RuntimeFault`` crossing guard.run — and every ``--strict``
+failure — dumps one bundle directory:
+
+    flight-NNN-<code>/
+        MANIFEST.json   schema cc-flight/1: fault, injected specs, ladder
+                        transitions, platform/env info, repro command, file
+                        listing
+        spans.jsonl     last-N spans as Chrome trace events (loadable in
+                        Perfetto like --trace-out output)
+        metrics.prom    full registry snapshot (Prometheus text)
+        events.jsonl    event-recorder ring tail
+        jaxpr.txt       the failing site's canonical entry re-captured under
+                        irgate (fault injection suspended), when the site
+                        maps to a jitted ladder entry and tools/ is present
+
+The recorder is bounded (oldest bundles pruned beyond ``max_bundles``),
+re-entrancy-guarded (a fault raised while dumping never recurses), and
+never lets a dump failure mask the fault being raised.
+
+The repro line synthesizes a ``CC_INJECT_FAULT`` spec from the fault's
+site + code, so re-running it deterministically re-triggers the same fault
+code through the real classifier path — whether the original fault was
+injected or organic.
+
+Import discipline: obs imports only utils and stdlib at module scope; the
+runtime faults harness and the irgate capture toolchain are imported lazily
+inside the dump path (post-mortem code, not the hot path).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import platform as platform_mod
+import shlex
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from ..utils import metrics as metrics_mod
+from . import export, names
+from . import spans as spans_mod
+
+FLIGHT_SCHEMA = "cc-flight/1"
+MANIFEST_NAME = "MANIFEST.json"
+DEFAULT_MAX_BUNDLES = 16
+
+# Tail sizes: a bundle is a triage artifact, not an archive.
+MAX_BUNDLE_SPANS = 256
+MAX_BUNDLE_EVENTS = 256
+MAX_JAXPR_BYTES = 200_000
+
+# fault site -> the canonical irgate ladder entry whose jaxpr best explains
+# the failing dispatch.  Host-side sites (engine.oracle) and sites without a
+# committed entry (parallel.interleave) are noted, not captured.
+SITE_TO_ENTRY = {
+    "engine.solve": "scan/n8",
+    "engine.fast_path": "fast_path/n8b3",
+    "parallel.solve_group": "solve_group/n8b3",
+    "engine.extenders": "extenders/n8",
+    "bounds.bracket": "bounds_bracket/n8b3",
+}
+
+# fault code -> injection kind producing the same code through the real
+# classifier (runtime/guard.classify_device_error); used for the repro spec.
+_CODE_TO_KIND = {
+    "DeviceOOM": "oom",
+    "CompileTimeout": "hang",
+    "ExecuteTimeout": "hang",
+    "NumericCorruption": "corrupt",
+}
+
+_state: Dict[str, Any] = {
+    "config": None,          # dict(dir, argv, max_bundles, capture_ir)
+    "in_dump": False,
+    "seq": 0,
+    "bundles": [],           # paths dumped this process, oldest first
+    "degradations": [],      # ladder transitions noted since install
+}
+
+
+def install(directory: str, *, argv: Optional[List[str]] = None,
+            max_bundles: int = DEFAULT_MAX_BUNDLES,
+            capture_ir: bool = True) -> None:
+    """Arm the recorder.  ``argv`` is the command line quoted into each
+    bundle's repro line (program name first)."""
+    os.makedirs(directory, exist_ok=True)
+    _state["config"] = {
+        "dir": directory,
+        "argv": list(argv) if argv else [],
+        "max_bundles": max(1, int(max_bundles)),
+        "capture_ir": bool(capture_ir),
+    }
+    _state["bundles"] = []
+    _state["degradations"] = []
+
+
+def installed() -> bool:
+    return _state["config"] is not None
+
+
+def uninstall() -> None:
+    _state["config"] = None
+    _state["bundles"] = []
+    _state["degradations"] = []
+
+
+def bundle_paths() -> List[str]:
+    """Bundles dumped by this process, oldest first (pruned ones removed)."""
+    return [p for p in _state["bundles"] if os.path.isdir(p)]
+
+
+def on_degradation(fault, next_rung: str) -> None:
+    """degrade._record's hook: note a ladder transition for the manifest."""
+    if _state["config"] is None:
+        return
+    ring = _state["degradations"]
+    ring.append(f"{getattr(fault, 'code', type(fault).__name__)}"
+                f"@{getattr(fault, 'site', '') or '?'} -> {next_rung}")
+    del ring[:-64]
+
+
+def on_fault(fault) -> Optional[str]:
+    """guard._record_fault_event's hook: dump a bundle for a classified
+    fault.  Returns the bundle path, or None (not installed / re-entrant /
+    dump failed — failures are reported to stderr, never raised)."""
+    if _state["config"] is None or _state["in_dump"]:
+        return None
+    _state["in_dump"] = True
+    try:
+        return _dump(fault)
+    except Exception as exc:
+        sys.stderr.write(f"obs.flight: bundle dump failed: {exc}\n")
+        return None
+    finally:
+        _state["in_dump"] = False
+
+
+class _StrictFailure:
+    """Fault-shaped stand-in for a --strict exit (no exception raised)."""
+
+    code = "StrictDegraded"
+
+    def __init__(self, detail: str, site: str = ""):
+        self.site = site
+        self.detail = {"reason": detail}
+        self._message = detail
+
+    def __str__(self) -> str:
+        return self._message
+
+
+def on_strict(detail: str) -> Optional[str]:
+    """CLI hook: a --strict run is about to exit non-zero because the solve
+    degraded; bundle the telemetry even though nothing raised."""
+    return on_fault(_StrictFailure(detail))
+
+
+def load_bundle(path: str) -> Dict[str, Any]:
+    """Round-trip a bundle directory back into dicts (triage tooling and
+    the chaos drills both go through this)."""
+    with open(os.path.join(path, MANIFEST_NAME), encoding="utf-8") as fh:
+        manifest = json.load(fh)
+    out: Dict[str, Any] = {"manifest": manifest, "spans": [], "events": [],
+                           "metrics": "", "jaxpr": None}
+    spans_path = os.path.join(path, "spans.jsonl")
+    if os.path.exists(spans_path):
+        with open(spans_path, encoding="utf-8") as fh:
+            out["spans"] = [json.loads(line) for line in fh if line.strip()]
+    events_path = os.path.join(path, "events.jsonl")
+    if os.path.exists(events_path):
+        with open(events_path, encoding="utf-8") as fh:
+            out["events"] = [json.loads(line) for line in fh if line.strip()]
+    metrics_path = os.path.join(path, "metrics.prom")
+    if os.path.exists(metrics_path):
+        with open(metrics_path, encoding="utf-8") as fh:
+            out["metrics"] = fh.read()
+    jaxpr_path = os.path.join(path, "jaxpr.txt")
+    if os.path.exists(jaxpr_path):
+        with open(jaxpr_path, encoding="utf-8") as fh:
+            out["jaxpr"] = fh.read()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# dump internals
+# ---------------------------------------------------------------------------
+
+def _repro(fault) -> Dict[str, Any]:
+    from ..runtime import faults
+    site = getattr(fault, "site", "") or ""
+    code = getattr(fault, "code", "") or ""
+    spec = ""
+    if site in faults.SITES:
+        spec = f"{site}:{_CODE_TO_KIND.get(code, 'error')}"
+    argv = _state["config"]["argv"]
+    if argv:
+        cmd = " ".join(shlex.quote(a) for a in argv)
+    else:
+        cmd = "<re-run the failing command>"
+    prefix = f"{faults.ENV_VAR}={shlex.quote(spec)} " if spec else ""
+    return {
+        "env": {faults.ENV_VAR: spec} if spec else {},
+        "argv": argv,
+        "line": prefix + cmd,
+    }
+
+
+def _platform_info() -> Dict[str, Any]:
+    info: Dict[str, Any] = {
+        "python": sys.version.split()[0],
+        "platform": platform_mod.platform(),
+        "env": {k: v for k, v in sorted(os.environ.items())
+                if k.startswith(("CC_", "JAX_", "XLA_"))},
+    }
+    try:
+        import jax
+        info["jax"] = jax.__version__
+        info["backend"] = jax.default_backend()
+    except Exception:
+        pass
+    return info
+
+
+def _capture_jaxpr(site: str) -> tuple:
+    """(jaxpr_text, note) for the failing site's canonical entry — re-driven
+    under irgate capture with fault injection suspended."""
+    entry_name = SITE_TO_ENTRY.get(site)
+    if entry_name is None:
+        return None, f"no canonical jitted entry for site {site!r}"
+    try:
+        from tools.irgate import capture as ir_cap
+        from tools.irgate import entries as ir_entries
+    except ImportError:
+        root = os.path.normpath(os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+        if root not in sys.path:
+            sys.path.insert(0, root)
+        try:
+            from tools.irgate import capture as ir_cap
+            from tools.irgate import entries as ir_entries
+        except ImportError:
+            return None, "irgate toolchain unavailable"
+    from ..runtime import faults
+    spec = next((s for s in ir_entries.canonical_entries()
+                 if s.name == entry_name), None)
+    if spec is None:
+        return None, f"entry {entry_name!r} missing from canonical ladder"
+    try:
+        with faults.suspended():
+            capture = ir_entries.run_entry(spec)
+    except RuntimeError as exc:
+        return None, f"irgate capture unavailable: {exc}"
+    if not capture.computations:
+        return None, f"entry {entry_name!r} captured no computations"
+    text = str(capture.computations[0].closed_jaxpr)
+    if len(text) > MAX_JAXPR_BYTES:
+        text = text[:MAX_JAXPR_BYTES] + "\n... [truncated]\n"
+    return text, entry_name
+
+
+def _dump(fault) -> str:
+    from ..runtime import faults
+    from ..utils.events import default_recorder
+
+    cfg = _state["config"]
+    # snapshot telemetry FIRST: the optional IR re-capture below dispatches
+    # real solves, which would otherwise pollute the bundle's span tail
+    span_tail = spans_mod.default_collector.spans()[-MAX_BUNDLE_SPANS:]
+    span_events = export.trace_events(span_tail)
+    metrics_text = metrics_mod.default_registry.render()
+    event_tail = default_recorder.events[-MAX_BUNDLE_EVENTS:]
+    injected = faults.installed_specs()
+
+    code = getattr(fault, "code", type(fault).__name__)
+    _state["seq"] += 1
+    name = f"flight-{_state['seq']:03d}-{code}"
+    path = os.path.join(cfg["dir"], name)
+    while os.path.exists(path):  # collision across processes
+        _state["seq"] += 1
+        name = f"flight-{_state['seq']:03d}-{code}"
+        path = os.path.join(cfg["dir"], name)
+    os.makedirs(path)
+
+    files = ["spans.jsonl", "metrics.prom", "events.jsonl"]
+    with open(os.path.join(path, "spans.jsonl"), "w",
+              encoding="utf-8") as fh:
+        for ev in span_events:
+            fh.write(json.dumps(ev) + "\n")
+    with open(os.path.join(path, "metrics.prom"), "w",
+              encoding="utf-8") as fh:
+        fh.write(metrics_text)
+    with open(os.path.join(path, "events.jsonl"), "w",
+              encoding="utf-8") as fh:
+        for ev in event_tail:
+            fh.write(json.dumps({
+                "reason": ev.reason, "message": ev.message,
+                "object": ev.object_name, "ts": ev.timestamp}) + "\n")
+
+    ir: Dict[str, Any] = {}
+    if cfg["capture_ir"]:
+        text, note = _capture_jaxpr(getattr(fault, "site", "") or "")
+        if text is not None:
+            with open(os.path.join(path, "jaxpr.txt"), "w",
+                      encoding="utf-8") as fh:
+                fh.write(text)
+            files.append("jaxpr.txt")
+            ir = {"entry": note, "file": "jaxpr.txt"}
+        else:
+            ir = {"note": note}
+    else:
+        ir = {"note": "ir capture disabled"}
+
+    manifest = {
+        "schema": FLIGHT_SCHEMA,
+        "created": time.time(),
+        "fault": {
+            "code": code,
+            "site": getattr(fault, "site", "") or "",
+            "message": str(fault),
+            "detail": getattr(fault, "detail", None),
+        },
+        "injected": injected,
+        "degradations": list(_state["degradations"]),
+        "platform": _platform_info(),
+        "repro": _repro(fault),
+        "ir": ir,
+        "files": files,
+    }
+    tmp = os.path.join(path, MANIFEST_NAME + ".tmp")
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(manifest, fh, indent=2, default=str)
+        fh.write("\n")
+    os.replace(tmp, os.path.join(path, MANIFEST_NAME))
+
+    _state["bundles"].append(path)
+    metrics_mod.default_registry.inc(names.FLIGHT_BUNDLES, code=code)
+    _prune(cfg)
+    sys.stderr.write(f"obs.flight: wrote {path}\n")
+    return path
+
+
+def _prune(cfg: Dict[str, Any]) -> None:
+    """Keep only the newest max_bundles bundle dirs in the flight dir."""
+    import shutil
+    try:
+        entries = [os.path.join(cfg["dir"], n)
+                   for n in os.listdir(cfg["dir"])
+                   if n.startswith("flight-")]
+        entries = [p for p in entries if os.path.isdir(p)]
+        entries.sort(key=lambda p: (os.path.getmtime(p), p))
+        for stale in entries[:-cfg["max_bundles"]]:
+            shutil.rmtree(stale, ignore_errors=True)
+    except OSError:
+        pass

@@ -17,6 +17,12 @@ Counters:
     cc_trace_spans_dropped_total                  span-buffer overflow
     cc_explains_total{rung}                       attribution artifacts built
         per solve rung (explain/artifacts.build_explanation)
+    cc_device_seconds_total{site,rung,phase}      accumulated guarded-dispatch
+        seconds — the device-time attribution surface (obs/profile.py); on
+        CPU fallback this is wall time inside the guard, on TPU it tracks
+        device occupancy because dispatch is serialized through guard.run
+    cc_flight_bundles_total{code}                 flight-recorder bundles
+        dumped per fault code (obs/flight.py)
 
 Gauges:
     cc_sweep_templates                    templates in the current sweep
@@ -24,6 +30,12 @@ Gauges:
     cc_resilience_scenarios{state}        total/completed scenario progress
     cc_explain_reason_nodes{reason}       nodes per terminal why-not reason
         in the most recent explained solve
+    cc_device_peak_bytes                  device memory watermark from
+        device.memory_stats() (graceful no-op where the backend — e.g. CPU —
+        exposes none; obs/profile.py samples it per guarded dispatch when
+        memory sampling is enabled)
+    cc_kernel_efficiency{entry,rung}      measured FLOPs rate / calibrated
+        platform rate per irgate ladder entry (obs/costmodel.py)
 
 Histograms:
     cc_guard_run_duration_seconds{site,rung,phase}   per-dispatch wall time
@@ -42,3 +54,7 @@ SWEEP_GROUPS = "cc_sweep_groups"
 SCENARIOS = "cc_resilience_scenarios"
 EXPLAINS = "cc_explains_total"
 EXPLAIN_REASON_NODES = "cc_explain_reason_nodes"
+DEVICE_SECONDS = "cc_device_seconds_total"
+DEVICE_PEAK_BYTES = "cc_device_peak_bytes"
+KERNEL_EFFICIENCY = "cc_kernel_efficiency"
+FLIGHT_BUNDLES = "cc_flight_bundles_total"

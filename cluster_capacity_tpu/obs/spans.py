@@ -174,5 +174,10 @@ def guard_span(*, site: str, phase: str, rung: str = "",
             lab = dict(site=site, rung=sp.rung or "-", phase=phase)
             reg.observe(names.GUARD_DURATION, dur, **lab)
             reg.inc(names.GUARD_RUNS, outcome=sp.outcome or "error", **lab)
+            reg.inc(names.DEVICE_SECONDS, dur, **lab)
             if sp.first_call:
                 reg.inc(names.GUARD_FIRST_CALLS, site=site)
+            # memory-watermark sample (fast no-op unless profiling enabled
+            # it); lazy import keeps spans importable before profile
+            from . import profile as profile_mod
+            profile_mod.maybe_sample(sp)

@@ -61,6 +61,7 @@ def _stamp(result, rung: str, degraded: bool):
 
 
 def _record(fault: RuntimeFault, next_rung: str) -> None:
+    from ..obs import flight
     from ..obs import names as obs_names
     from ..utils.events import default_recorder
     from ..utils.metrics import default_registry
@@ -70,6 +71,9 @@ def _record(fault: RuntimeFault, next_rung: str) -> None:
         "solve", EVENT_DEGRADED,
         f"{fault.code} at {fault.site or '?'}: falling back to "
         f"{next_rung}: {fault}")
+    # the flight recorder notes the transition so a bundle's manifest shows
+    # the full descent, not only the fault that triggered the dump
+    flight.on_degradation(fault, next_rung)
 
 
 def _solve_oracle(pb, max_limit: int = 0, explain: bool = False):

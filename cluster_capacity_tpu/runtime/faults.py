@@ -145,6 +145,49 @@ def clear() -> None:
         _state.env_loaded = False
 
 
+def spec_text(spec: FaultSpec) -> str:
+    """The ``site:kind[:at[:times]]`` form of a spec — round-trips through
+    parse_spec, so a flight bundle can quote exactly what was installed."""
+    if spec.at == 1 and spec.times == 1:
+        return f"{spec.site}:{spec.kind}"
+    return f"{spec.site}:{spec.kind}:{spec.at}:{spec.times}"
+
+
+def installed_specs() -> List[str]:
+    """Every currently-installed spec (env var included) as repro text, in
+    site order.  Read-only; used by the flight recorder's manifest."""
+    with _lock:
+        _load_env_locked()
+        out: List[str] = []
+        for site in sorted(_state.specs):
+            out.extend(spec_text(s) for s in _state.specs[site])
+        return out
+
+
+@contextmanager
+def suspended():
+    """Disable ALL fault injection — installed specs and the env var — for
+    the duration of the block, restoring specs and call counters after.
+
+    The flight recorder (obs/flight.py) re-drives a failing entry under
+    irgate capture to snapshot its jaxpr; without this, the very fault being
+    triaged would re-fire inside the post-mortem and recurse."""
+    with _lock:
+        saved_specs = _state.specs
+        saved_calls = _state.calls
+        saved_env = _state.env_loaded
+        _state.specs = {}
+        _state.calls = {}
+        _state.env_loaded = True  # blocks _load_env_locked re-reading ENV_VAR
+    try:
+        yield
+    finally:
+        with _lock:
+            _state.specs = saved_specs
+            _state.calls = saved_calls
+            _state.env_loaded = saved_env
+
+
 def _load_env_locked() -> None:
     if _state.env_loaded:
         return

@@ -122,6 +122,10 @@ def _record_fault_event(fault) -> None:
     this one names the fault itself, with its site and detail)."""
     from ..utils.events import default_recorder
     default_recorder.eventf("device", fault.code, str(fault))
+    # flight recorder: dump a triage bundle when one is installed (fast
+    # no-op otherwise; dump failures never mask the fault being raised)
+    from ..obs import flight
+    flight.on_fault(fault)
 
 
 def run(fn, *args, site: str, deadline: float = 0.0,

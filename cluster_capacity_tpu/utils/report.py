@@ -59,42 +59,49 @@ class ClusterCapacityReview:
     # flag tells the operator the device path misbehaved
     degraded: bool = False
     rung: str = ""
+    # flight-recorder bundles dumped during the run (obs/flight.py); the
+    # key only appears in the envelope when the recorder was armed AND
+    # something faulted, so existing golden reports are unaffected
+    flight_bundles: List[str] = field(default_factory=list)
 
     def to_dict(self) -> dict:
         """Stable machine-readable schema: a {"spec", "status"} envelope —
         shared with the resilience SurvivabilityReport (resilience/
         analyzer.py) so every report kind round-trips through from_dict."""
+        status = {
+            "creationTimestamp": self.creation_timestamp,
+            "replicas": self.replicas,
+            "degraded": self.degraded,
+            "rung": self.rung,
+            "failReason": {
+                "failType": self.fail_type,
+                "failMessage": self.fail_message,
+            },
+            "pods": [
+                {
+                    "podName": p.pod_name,
+                    "replicasOnNodes": [
+                        {"nodeName": r.node_name, "replicas": r.replicas}
+                        for r in p.replicas_on_nodes
+                    ],
+                    "failSummary": p.fail_summary,
+                    "reasons": ({k: int(v) for k, v in
+                                 sorted(p.reasons.items())}
+                                if p.reasons else None),
+                    "explain": p.explain,
+                }
+                for p in self.pods
+            ],
+        }
+        if self.flight_bundles:
+            status["flightBundles"] = list(self.flight_bundles)
         return {
             "spec": {
                 "templates": self.templates,
                 "replicas": 0,
                 "podRequirements": self.pod_requirements,
             },
-            "status": {
-                "creationTimestamp": self.creation_timestamp,
-                "replicas": self.replicas,
-                "degraded": self.degraded,
-                "rung": self.rung,
-                "failReason": {
-                    "failType": self.fail_type,
-                    "failMessage": self.fail_message,
-                },
-                "pods": [
-                    {
-                        "podName": p.pod_name,
-                        "replicasOnNodes": [
-                            {"nodeName": r.node_name, "replicas": r.replicas}
-                            for r in p.replicas_on_nodes
-                        ],
-                        "failSummary": p.fail_summary,
-                        "reasons": ({k: int(v) for k, v in
-                                     sorted(p.reasons.items())}
-                                    if p.reasons else None),
-                        "explain": p.explain,
-                    }
-                    for p in self.pods
-                ],
-            },
+            "status": status,
         }
 
     @classmethod
@@ -121,6 +128,7 @@ class ClusterCapacityReview:
             creation_timestamp=status.get("creationTimestamp", ""),
             degraded=status.get("degraded", False),
             rung=status.get("rung", ""),
+            flight_bundles=list(status.get("flightBundles") or []),
         )
 
 

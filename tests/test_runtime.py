@@ -710,3 +710,128 @@ def test_resilience_cli_journal_resume_and_strict(tmp_path, capsys):
     # the journal replays the degraded-but-bit-identical results — resume
     # must preserve provenance, not launder it
     assert resumed["status"]["degraded"] is True
+
+
+# --- flight-recorder drills: every fault site (PR 9) -------------------------
+
+from cluster_capacity_tpu.obs import flight  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_flight():
+    flight.uninstall()
+    yield
+    flight.uninstall()
+
+
+def _flight_drill(site):
+    """(driver, reach_specs, propagates) for one fault site.
+
+    ``reach_specs`` are the upper-rung faults needed so the ladder actually
+    dispatches the target site; ``propagates`` marks sites with no rung
+    below them (the classified fault escapes instead of degrading)."""
+    if site in ("engine.solve", "engine.fast_path", "engine.oracle"):
+        reach = {
+            "engine.solve": (),
+            "engine.fast_path": ("engine.solve:oom",),
+            "engine.oracle": ("engine.solve:oom:1:0",
+                              "engine.fast_path:oom:1:0"),
+        }[site]
+        return (lambda: degrade.solve_one_guarded(_pb()), reach,
+                site == "engine.oracle")
+    if site == "parallel.solve_group":
+        return lambda: degrade.solve_group_guarded(_group_pbs()), (), False
+    if site == "engine.extenders":
+        from cluster_capacity_tpu import ClusterCapacity
+        from cluster_capacity_tpu.engine.extenders import ExtenderConfig
+
+        def drive():
+            profile = SchedulerProfile()
+            profile.extenders = [ExtenderConfig(
+                bind_callable=lambda p, n: {})]
+            cc = ClusterCapacity(_probe(100), max_limit=2, profile=profile)
+            cc.sync_with_objects(
+                [build_test_node("n1", 1000, int(1e9), 10)], [])
+            cc.run()
+        return drive, (), True
+    if site == "parallel.interleave":
+        from cluster_capacity_tpu.parallel.interleave import (
+            sweep_interleaved_auto)
+
+        def drive():
+            snap = ClusterSnapshot.from_objects(
+                [build_test_node(f"n{i}", 2000, int(1e9), 8)
+                 for i in range(3)])
+            sweep_interleaved_auto(
+                snap, [_probe(200, name="a"), _probe(300, name="b")],
+                max_total=4)
+        return drive, (), False
+    assert site == "bounds.bracket"
+    from cluster_capacity_tpu import bounds
+
+    def drive():
+        bounds.bracket_group([_pb()])
+    return drive, (), False
+
+
+@pytest.mark.parametrize("site", faults.SITES)
+def test_every_fault_site_yields_loadable_repro_bundle(site, tmp_path):
+    """Acceptance drill: an injected OOM at ANY dispatch site dumps a
+    bundle that round-trips through load_bundle, and the bundle's repro
+    spec re-triggers the same fault code at the same site."""
+    drive, reach, propagates = _flight_drill(site)
+    flight.install(str(tmp_path), argv=["hypercc", "x"], capture_ir=False)
+
+    def run_with(spec):
+        with faults.inject(*reach, spec):
+            if propagates:
+                with pytest.raises(RuntimeFault):
+                    drive()
+            else:
+                drive()
+
+    def site_bundles():
+        out = []
+        for p in flight.bundle_paths():
+            b = flight.load_bundle(p)
+            if b["manifest"]["fault"]["site"] == site:
+                out.append(b)
+        return out
+
+    run_with(f"{site}:oom")
+    first = site_bundles()
+    assert first, f"no bundle dumped for {site}"
+    man = first[-1]["manifest"]
+    assert man["schema"] == flight.FLIGHT_SCHEMA
+    assert man["fault"]["code"] == "DeviceOOM"
+    assert f"{site}:oom" in man["injected"]
+    assert "cc_" in first[-1]["metrics"]
+    assert first[-1]["spans"], f"span tail empty for {site}"
+
+    repro_spec = man["repro"]["env"].get(faults.ENV_VAR)
+    assert repro_spec == f"{site}:oom"
+    assert f"{faults.ENV_VAR}={site}:oom" in man["repro"]["line"]
+
+    faults.clear()
+    run_with(repro_spec)
+    again = site_bundles()
+    assert len(again) > len(first), f"repro spec silent at {site}"
+    assert again[-1]["manifest"]["fault"]["code"] == "DeviceOOM"
+
+
+def test_flight_repro_round_trips_through_env_var(tmp_path, monkeypatch):
+    """The repro line's CC_INJECT_FAULT env var (not just inject()) re-arms
+    the same fault: the exact mechanism a human pasting the repro uses."""
+    flight.install(str(tmp_path), capture_ir=False)
+    with faults.inject("engine.solve:oom"):
+        degrade.solve_one_guarded(_pb())
+    man = flight.load_bundle(flight.bundle_paths()[-1])["manifest"]
+    faults.clear()
+    monkeypatch.setenv(faults.ENV_VAR, man["repro"]["env"][faults.ENV_VAR])
+    faults.clear()                       # re-reads the env var on next fire
+    res = degrade.solve_one_guarded(_pb())
+    assert res.degraded
+    assert len(flight.bundle_paths()) == 2
+    man2 = flight.load_bundle(flight.bundle_paths()[-1])["manifest"]
+    assert man2["fault"]["code"] == "DeviceOOM"
+    assert man2["fault"]["site"] == "engine.solve"

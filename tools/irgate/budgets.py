@@ -63,6 +63,21 @@ def save(entries: Dict[str, Dict[str, Any]], path: str = DEFAULT_PATH,
         fh.write("\n")
 
 
+def entry_costs(path: str = DEFAULT_PATH) -> Dict[str, Dict[str, float]]:
+    """Flat {entry: {primitives, flops, live_bytes}} join surface for the
+    runtime cost-model calibration: obs/costmodel.py joins measured device
+    seconds per canonical entry against these static pins to produce the
+    cc_kernel_efficiency ratios.  Empty when no budgets are committed."""
+    doc = load(path)
+    if doc is None:
+        return {}
+    out: Dict[str, Dict[str, float]] = {}
+    for name, pin in (doc.get("entries") or {}).items():
+        out[name] = {m: float(pin.get(m, 0) or 0)
+                     for m in ("primitives", "flops", "live_bytes")}
+    return out
+
+
 def _pct(new: float, old: float) -> float:
     if old == 0:
         return 0.0 if new == 0 else float("inf")

@@ -9,7 +9,12 @@ Flags:
 
   --pins PATH      compare against an alternate pins file
   --update-pins    rewrite the pins file from this artifact's metrics
+                   (hand-curated efficiency_floors carry through untouched)
   --tolerance PCT  tolerance band written by --update-pins (default 10)
+  --calibration F  a `hypercc profile` calibration.json: kernel-efficiency
+                   ratios checked against the pins' efficiency_floors —
+                   PG004 findings are informational and never flip the
+                   exit code
   --json           print the machine-readable report to stdout
   --json-out FILE  write the same report to FILE (tools/ci.py runs steps
                    without a shell, so `>` redirection is unavailable)
@@ -34,6 +39,9 @@ def main(argv=None) -> int:
     ap.add_argument("--update-pins", action="store_true")
     ap.add_argument("--tolerance", type=float,
                     default=gate.DEFAULT_TOLERANCE_PCT, metavar="PCT")
+    ap.add_argument("--calibration", metavar="FILE", default="",
+                    help="hypercc profile calibration.json for the "
+                         "informational PG004 efficiency check")
     ap.add_argument("--json", action="store_true")
     ap.add_argument("--json-out", metavar="FILE")
     args = ap.parse_args(argv)
@@ -48,14 +56,20 @@ def main(argv=None) -> int:
     bench = gate.load_bench(bench_path)
 
     if args.update_pins:
-        doc = gate.make_pins(bench, bench_path, tolerance_pct=args.tolerance)
+        doc = gate.make_pins(bench, bench_path, tolerance_pct=args.tolerance,
+                             prev=gate.load_pins(args.pins))
         gate.save_pins(doc, args.pins)
         print(f"perfgate: pinned {len(doc['metrics'])} metric floor(s) "
               f"from {os.path.basename(bench_path)} to "
               f"{os.path.relpath(args.pins, gate.ROOT)}")
         return 0
 
-    findings, skip = gate.compare(bench, gate.load_pins(args.pins))
+    pins = gate.load_pins(args.pins)
+    findings, skip = gate.compare(bench, pins)
+    info = []
+    if args.calibration:
+        with open(args.calibration, "r", encoding="utf-8") as fh:
+            info = gate.efficiency_findings(json.load(fh), pins)
     doc = {
         "perfgate": 1,
         "bench": os.path.basename(bench_path),
@@ -63,6 +77,8 @@ def main(argv=None) -> int:
         "skipped": skip,
         "findings": [{"metric": f.metric, "rule": f.rule,
                       "message": f.message} for f in findings],
+        "informational": [{"metric": f.metric, "rule": f.rule,
+                           "message": f.message} for f in info],
     }
     if args.json_out:
         with open(args.json_out, "w", encoding="utf-8") as fh:
@@ -75,10 +91,13 @@ def main(argv=None) -> int:
             print(f"perfgate: skipped — {skip}")
         for f in findings:
             print(f.render())
+        for f in info:
+            print(f"{f.render()} [informational]")
         if not skip:
             n = len(gate.gated_metrics(bench))
             print(f"perfgate: {os.path.basename(bench_path)}: {n} gated "
-                  f"metric(s), {len(findings)} finding(s)")
+                  f"metric(s), {len(findings)} finding(s)"
+                  + (f", {len(info)} informational" if info else ""))
     return 1 if findings else 0
 
 

@@ -13,6 +13,12 @@ Rules:
   PG002  regression: metric fell below floor*(1 - tolerance)
   PG003  pinned metric missing from the bench artifact (stale pin or a
          scenario that stopped producing its key)
+  PG004  (informational only — never fails the gate) calibrated kernel
+         efficiency below the optional ``efficiency_floors`` pins; the
+         ratios come from obs/costmodel.py via `hypercc profile` and are
+         measured on whatever host ran them, so a hard floor would gate
+         the weather — the finding names the entry and ratio, the exit
+         code ignores it
 
 A platform change (cpu pins vs a tpu run, or vice versa) is a *skip*, not
 a failure: floors are platform-specific by nature, exactly like the bench
@@ -140,15 +146,20 @@ def load_pins(path: str = DEFAULT_PINS) -> Optional[Dict[str, Any]]:
 
 
 def make_pins(bench: Dict[str, Any], source: str,
-              tolerance_pct: float = DEFAULT_TOLERANCE_PCT
-              ) -> Dict[str, Any]:
-    return {
+              tolerance_pct: float = DEFAULT_TOLERANCE_PCT,
+              prev: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    doc = {
         "_comment": _HEADER,
         "platform": bench.get("platform", "unknown"),
         "source": os.path.basename(source),
         "tolerance_pct": float(tolerance_pct),
         "metrics": dict(sorted(gated_metrics(bench).items())),
     }
+    # informational efficiency floors (PG004) are hand-curated, not derived
+    # from a bench artifact — carry them through a re-pin untouched
+    if prev and isinstance(prev.get("efficiency_floors"), dict):
+        doc["efficiency_floors"] = dict(prev["efficiency_floors"])
+    return doc
 
 
 def save_pins(doc: Dict[str, Any], path: str = DEFAULT_PINS) -> None:
@@ -203,3 +214,30 @@ def compare(bench: Dict[str, Any], pins: Optional[Dict[str, Any]]
                 "or a scenario stopped producing its key; run "
                 "--update-pins if the removal was deliberate"))
     return (findings, None)
+
+
+def efficiency_findings(calibration: Optional[Dict[str, Any]],
+                        pins: Optional[Dict[str, Any]]
+                        ) -> List[PerfFinding]:
+    """PG004, informational only: calibrated kernel-efficiency ratios
+    (obs/costmodel.py report, or a `hypercc profile` calibration.json)
+    vs the optional ``efficiency_floors`` map in pins.json.  The caller
+    prints these but they NEVER affect the gate's exit code — efficiency
+    is measured on whatever host happened to run the calibration."""
+    floors = (pins or {}).get("efficiency_floors") or {}
+    entries = (calibration or {}).get("entries") or {}
+    out: List[PerfFinding] = []
+    for name in sorted(entries):
+        entry = entries[name]
+        eff = entry.get("efficiency") if isinstance(entry, dict) else None
+        floor = floors.get(name)
+        if not isinstance(eff, (int, float)) \
+                or not isinstance(floor, (int, float)):
+            continue
+        if eff < floor:
+            out.append(PerfFinding(
+                name, "PG004",
+                f"kernel efficiency {eff:.3f} below informational floor "
+                f"{floor:g} (calibration: obs/costmodel.py via "
+                f"`hypercc profile`; does not fail the gate)"))
+    return out

@@ -1,0 +1,138 @@
+"""Profile/flight smoke: drive `hypercc profile` in-process and assert the
+artifact schemas the deep-profiling layer promises (ci.yaml step).
+
+Three checks, all on a tiny synthetic cluster:
+
+1. attribution — a --no-calibrate scenario run writes attribution.json
+   with the cc-attribution/1 schema and at least one sited row carrying
+   the site/rung/phase split;
+2. calibration — a single-rep calibration pass writes calibration.json
+   with the cc-calibration/1 schema and an efficiency ratio for every
+   canonical irgate ladder entry;
+3. flight — an injected engine.solve OOM under --flight-dir produces a
+   loadable cc-flight/1 bundle whose manifest names the fault code and
+   whose repro line carries the CC_INJECT_FAULT spec.
+
+Runs without a shell (tools/ci.py executes steps directly), so all
+assertions live here rather than in a grep pipeline.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _fail(msg: str) -> None:
+    print(f"profile-smoke: FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def _check_attribution(profile_cli, obs_profile) -> None:
+    with tempfile.TemporaryDirectory() as td:
+        out = os.path.join(td, "prof")
+        rc = profile_cli.run(["solve", "--nodes", "8", "--no-calibrate",
+                              "--profile-out", out])
+        if rc != 0:
+            _fail(f"profile solve exited {rc}")
+        path = os.path.join(out, "attribution.json")
+        if not os.path.exists(path):
+            _fail("attribution.json not written")
+        with open(path, encoding="utf-8") as fh:
+            doc = json.load(fh)
+        if doc.get("schema") != obs_profile.ATTRIBUTION_SCHEMA:
+            _fail(f"attribution schema {doc.get('schema')!r} != "
+                  f"{obs_profile.ATTRIBUTION_SCHEMA!r}")
+        rows = doc.get("rows")
+        if not rows:
+            _fail("attribution.json has no rows")
+        for row in rows:
+            missing = [k for k in ("site", "rung", "phase", "calls",
+                                   "device_s") if k not in row]
+            if missing:
+                _fail(f"attribution row missing keys {missing}: {row}")
+        print(f"profile-smoke: attribution OK ({len(rows)} row(s))")
+
+
+def _check_calibration(profile_cli, costmodel) -> None:
+    with tempfile.TemporaryDirectory() as td:
+        out = os.path.join(td, "prof")
+        rc = profile_cli.run(["solve", "--nodes", "8",
+                              "--calibrate-reps", "1",
+                              "--profile-out", out])
+        if rc != 0:
+            _fail(f"profile calibration run exited {rc}")
+        path = os.path.join(out, "calibration.json")
+        if not os.path.exists(path):
+            _fail("calibration.json not written")
+        with open(path, encoding="utf-8") as fh:
+            doc = json.load(fh)
+        if doc.get("schema") != costmodel.CALIBRATION_SCHEMA:
+            _fail(f"calibration schema {doc.get('schema')!r} != "
+                  f"{costmodel.CALIBRATION_SCHEMA!r}")
+        entries = doc.get("entries") or {}
+        if not entries:
+            _fail("calibration.json has no entries")
+        bad = [n for n, e in entries.items()
+               if not isinstance(e.get("efficiency"), (int, float))]
+        if bad:
+            _fail(f"entries without an efficiency ratio: {sorted(bad)}")
+        print(f"profile-smoke: calibration OK ({len(entries)} entries, "
+              f"platform {doc.get('platform')})")
+
+
+def _check_flight(profile_cli, flight, faults) -> None:
+    with tempfile.TemporaryDirectory() as td:
+        fdir = os.path.join(td, "flight")
+        try:
+            rc = profile_cli.run([
+                "solve", "--nodes", "8", "--no-calibrate",
+                "--flight-dir", fdir,
+                "--inject-fault", "engine.solve:oom"])
+            if rc != 0:
+                _fail(f"profile flight run exited {rc}")
+            bundles = flight.bundle_paths()
+            if not bundles:
+                _fail("injected fault produced no flight bundle")
+            bundle = flight.load_bundle(bundles[-1])
+        finally:
+            flight.uninstall()
+            faults.clear()
+        man = bundle["manifest"]
+        if man.get("schema") != flight.FLIGHT_SCHEMA:
+            _fail(f"bundle schema {man.get('schema')!r} != "
+                  f"{flight.FLIGHT_SCHEMA!r}")
+        if (man.get("fault") or {}).get("code") != "DeviceOOM":
+            _fail(f"bundle fault code {man.get('fault')!r}")
+        line = (man.get("repro") or {}).get("line", "")
+        if "CC_INJECT_FAULT=engine.solve:oom" not in line:
+            _fail(f"repro line missing injection spec: {line!r}")
+        if not bundle["spans"]:
+            _fail("bundle spans.jsonl is empty or unparseable")
+        print(f"profile-smoke: flight OK (bundle "
+              f"{os.path.basename(bundles[-1])}, {len(bundle['spans'])} "
+              f"span(s))")
+
+
+def main() -> int:
+    if ROOT not in sys.path:
+        sys.path.insert(0, ROOT)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from cluster_capacity_tpu.cli import profile as profile_cli
+    from cluster_capacity_tpu.obs import costmodel, flight
+    from cluster_capacity_tpu.obs import profile as obs_profile
+    from cluster_capacity_tpu.runtime import faults
+
+    _check_attribution(profile_cli, obs_profile)
+    _check_calibration(profile_cli, costmodel)
+    _check_flight(profile_cli, flight, faults)
+    print("profile-smoke: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -58,16 +58,20 @@ def _load(path: str) -> Optional[dict]:
 
 
 def collect(root: str = ROOT) -> dict:
-    """{"rounds": [..], "metrics": {name: {round: value}}, "gates": {...}}.
+    """{"rounds": [..], "metrics": {name: {round: value}}, "gates": {...},
+    "phases": {round: {scenario: block}}}.
 
     Bench rounds contribute their headline metric (parsed["metric"] →
     parsed["value"]) plus every other numeric key of the parsed line;
     multichip rounds contribute multichip_ok / multichip_devices.  Gate
     reports (IRGATE.json / PERFGATE.json, when CI committed them) ride
-    along un-rounded as current-state verdicts.
+    along un-rounded as current-state verdicts.  The per-scenario "phases"
+    blocks (warmup/steady split, recompiles, device attribution) are kept
+    whole so ``regressions`` can name the phase a drop lives in.
     """
     rounds: set = set()
     metrics: Dict[str, Dict[int, float]] = {}
+    phases: Dict[int, Dict[str, dict]] = {}
 
     def put(name: str, rnd: int, value) -> None:
         if isinstance(value, bool):
@@ -88,6 +92,8 @@ def collect(root: str = ROOT) -> dict:
         for k, v in parsed.items():
             if k not in _NON_METRIC_KEYS:
                 put(k, rnd, v)
+        if isinstance(parsed.get("phases"), dict):
+            phases[rnd] = parsed["phases"]
 
     for rnd, path in _artifact_files(root, "MULTICHIP_r*.json"):
         doc = _load(path)
@@ -107,12 +113,52 @@ def collect(root: str = ROOT) -> dict:
             gates[name] = {"clean": bool(doc.get("clean")),
                            "findings": len(doc.get("findings") or [])}
 
-    return {"rounds": sorted(rounds), "metrics": metrics, "gates": gates}
+    return {"rounds": sorted(rounds), "metrics": metrics, "gates": gates,
+            "phases": phases}
+
+
+def _phase_num(block, *keys) -> float:
+    cur = block
+    for k in keys:
+        cur = cur.get(k) if isinstance(cur, dict) else None
+    return float(cur) if isinstance(cur, (int, float)) \
+        and not isinstance(cur, bool) else 0.0
+
+
+def name_phase(before, after) -> str:
+    """Attribute a throughput drop to a phase from two per-scenario
+    "phases" blocks (bench.py artifact): "compile" when recompiles or
+    backend compile seconds grew at least as much as steady time,
+    "execute" when steady time grew and the guarded device-time
+    attribution grew comparably (>= half the steady growth), "host" when
+    steady grew but device time stayed flat — the slowdown is outside the
+    kernels.  Empty string when either round lacks a phases block (deltas
+    against a missing baseline would attribute absolute costs, not
+    growth)."""
+    if not isinstance(after, dict) or not isinstance(before, dict):
+        return ""
+    b = before
+    d_recompiles = _phase_num(after, "recompiles") - _phase_num(
+        b, "recompiles")
+    d_compile = _phase_num(after, "backend_compile_s") - _phase_num(
+        b, "backend_compile_s")
+    d_steady = _phase_num(after, "steady_s") - _phase_num(b, "steady_s")
+    d_device = _phase_num(after, "device", "device_s") - _phase_num(
+        b, "device", "device_s")
+    if d_recompiles > 0 or (d_compile > 0 and d_compile >= d_steady):
+        return "compile"
+    if d_steady > 0:
+        return "execute" if d_device >= 0.5 * d_steady else "host"
+    return ""
 
 
 def regressions(data: dict) -> List[dict]:
     """Throughput metrics whose most recent reporting round dropped more
-    than REGRESSION_PCT below the round before it."""
+    than REGRESSION_PCT below the round before it.  When both rounds
+    carry a phases block for the metric's scenario, the finding also
+    names the suspect phase (compile / execute / host)."""
+    from ..perfgate.gate import scenario_for
+    phases = data.get("phases") or {}
     out = []
     for name, series in sorted(data["metrics"].items()):
         if not name.endswith(_RATE_SUFFIXES):
@@ -122,12 +168,20 @@ def regressions(data: dict) -> List[dict]:
             continue
         prev, cur = series[rnds[-2]], series[rnds[-1]]
         if prev > 0 and cur < prev * (1 - REGRESSION_PCT / 100.0):
-            out.append({
+            reg = {
                 "metric": name,
                 "from_round": rnds[-2], "to_round": rnds[-1],
                 "before": prev, "after": cur,
                 "drop_pct": round(100.0 * (1 - cur / prev), 1),
-            })
+            }
+            scenario = scenario_for(name)
+            phase = name_phase(
+                (phases.get(rnds[-2]) or {}).get(scenario),
+                (phases.get(rnds[-1]) or {}).get(scenario))
+            if phase:
+                reg["phase"] = phase
+                reg["scenario"] = scenario
+            out.append(reg)
     return out
 
 
@@ -162,10 +216,12 @@ def render_markdown(data: dict, regs: List[dict]) -> str:
     lines += ["", "## Regressions", ""]
     if regs:
         for r in regs:
+            note = (f"; suspect phase: {r['phase']} "
+                    f"(phases[{r['scenario']}])") if r.get("phase") else ""
             lines.append(
                 f"- **{r['metric']}**: {_fmt(r['before'])} → "
                 f"{_fmt(r['after'])} (-{r['drop_pct']}% between "
-                f"r{r['from_round']:02d} and r{r['to_round']:02d})")
+                f"r{r['from_round']:02d} and r{r['to_round']:02d}{note})")
     else:
         lines.append("none flagged (throughput metrics within "
                      f"{REGRESSION_PCT:g}% of the previous round)")

@@ -38,6 +38,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     with open(json_out, "w", encoding="utf-8") as fh:
         json.dump({"trend": 1, "rounds": data["rounds"],
                    "metrics": data["metrics"], "gates": data["gates"],
+                   "phases": data.get("phases") or {},
                    "regressions": regs}, fh, indent=2, sort_keys=True)
         fh.write("\n")
 
