@@ -7,7 +7,7 @@ CXX ?= g++
 CXXFLAGS ?= -O2 -std=c++17 -fPIC -Wall
 NATIVE_LIB := cluster_capacity_tpu/models/libccsnap.so
 
-.PHONY: all build native lint test-unit test-parity test-fuzz test-dist test-integration test-e2e bench perfgate trend chaos profile-smoke clean verify-native ci
+.PHONY: all build native lint test-unit test-parity test-fuzz test-dist test-integration test-e2e bench multichip perfgate trend chaos profile-smoke clean verify-native ci
 
 all: build
 
@@ -68,6 +68,15 @@ test-e2e:
 
 bench:
 	$(PY) bench.py
+
+# Fleet-scale mesh-sharded sweep bench (tools/multichip_bench.py): N-1
+# resilience sweep over a synthetic 2k-node fleet on a virtual 8-device
+# CPU mesh; proves sharded == unsharded bit-identity twice (bounds-pruned
+# pass + forced-solve pass) and records placements/s (total and per
+# device) into MULTICHIP_r06.json for tools/perfgate and tools/trend.
+multichip:
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORM_NAME=cpu \
+		$(PY) -m tools.multichip_bench --out MULTICHIP_r06.json
 
 # Throughput regression gate: latest committed BENCH_r*.json vs the pinned
 # floors in tools/perfgate/pins.json (the perf counterpart of irgate's

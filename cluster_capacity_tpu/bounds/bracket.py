@@ -223,9 +223,16 @@ def _quantize_batch(b: int) -> int:
 
 
 @functools.lru_cache(maxsize=16)
-def _bracket_runner(num_constraints: int, num_domains: int):
+def _bracket_runner(num_constraints: int, num_domains: int, mesh=None):
     """Jitted bracket kernel, vmapped over the batch axis.  Static on the
-    hard-constraint/domain counts; shapes (N, R, B) specialize via jit."""
+    hard-constraint/domain counts; shapes (N, R, B) specialize via jit.
+
+    With a mesh the same kernel is jitted under explicit in/out shardings:
+    the batch axis (scenarios) over the mesh's "batch" axis, the node
+    tables over "nodes" — the per-node floors reduce to per-problem scalars
+    through XLA cross-shard collectives, so the pruning brackets shard the
+    same way the sweep they right-size does (inputs must already be padded
+    to the shard multiples; `bracket_device` does that)."""
     import jax
     import jax.numpy as jnp
 
@@ -263,7 +270,27 @@ def _bracket_runner(num_constraints: int, num_domains: int):
             lower = jnp.minimum(lower, upper)
         return lower, upper, lp
 
-    return jax.jit(jax.vmap(one))
+    vm = jax.vmap(one)
+    if mesh is None:
+        return jax.jit(vm)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from ..parallel.mesh import BATCH_AXIS, NODE_AXIS
+
+    def s(*parts):
+        return NamedSharding(mesh, P(BATCH_AXIS, *parts))
+
+    in_sh = (s(NODE_AXIS, None),             # free [B, N, R]
+             s(None),                        # req [B, R]
+             s(NODE_AXIS),                   # pods_free [B, N]
+             s(NODE_AXIS),                   # gate [B, N]
+             s(None, NODE_AXIS),             # dom [B, C, N]
+             s(None, None),                  # e [B, C, D]
+             s(None, None),                  # valid [B, C, D]
+             s(None),                        # skew [B, C]
+             s(None),                        # mindom [B, C]
+             s(None))                        # selfm [B, C]
+    out_sh = (s(), s(), s())                 # lower/upper/lp [B]
+    return jax.jit(vm, in_shardings=in_sh, out_shardings=out_sh)
 
 
 def _spread_arrays(pb: enc.EncodedProblem, ch: int, dh: int, n: int):
@@ -288,12 +315,20 @@ def _spread_arrays(pb: enc.EncodedProblem, ch: int, dh: int, n: int):
     return dom, e, valid, skew, mindom, selfm
 
 
-def bracket_device(pbs: Sequence[enc.EncodedProblem]) -> List[CapacityBracket]:
+def bracket_device(pbs: Sequence[enc.EncodedProblem], *,
+                   mesh=None) -> List[CapacityBracket]:
     """ONE batched device shot bracketing every problem: the fit planes (and
     any hard-spread planes, padded to group maxima) stack on a quantized
     leading axis and run through the vmapped kernel.  Problems must share
     the node/resource axes (the analyzer's scenario family and a sweep's
     template group both do).
+
+    With a mesh the planes are padded to the shard multiples (pad scenarios
+    are all-infeasible rows whose outputs are never read; pad nodes are
+    gate-False, domainless — zero-capacity, so every reduction ignores
+    them) and the shot runs under the sharded runner.  The host parity
+    check in `bracket_group` covers the sharded shot the same as the
+    unsharded one.
 
     Dispatch-set member (tools/irgate GD001): route every call through
     runtime/guard.run under faults.SITE_BOUNDS — `bracket_group` is the
@@ -344,7 +379,27 @@ def bracket_device(pbs: Sequence[enc.EncodedProblem]) -> List[CapacityBracket]:
 
     lo = hi = lp = None
     if kernel_rows:
-        runner = _bracket_runner(c_eff, dh)
+        if mesh is not None:
+            from ..parallel import mesh as mesh_lib
+            nb = int(mesh.shape[mesh_lib.BATCH_AXIS])
+            nn = int(mesh.shape[mesh_lib.NODE_AXIS])
+            bq2 = -(-bq // nb) * nb
+            n2 = -(-n // nn) * nn
+            free = mesh_lib._pad_axis(
+                mesh_lib._pad_axis(free, 0, bq2, 0), 1, n2, 0)
+            req = mesh_lib._pad_axis(req, 0, bq2, 0)
+            pods_free = mesh_lib._pad_axis(
+                mesh_lib._pad_axis(pods_free, 0, bq2, 0), 1, n2, 0)
+            gate = mesh_lib._pad_axis(
+                mesh_lib._pad_axis(gate, 0, bq2, False), 1, n2, False)
+            dom = mesh_lib._pad_axis(
+                mesh_lib._pad_axis(dom, 0, bq2, -1), 2, n2, -1)
+            e = mesh_lib._pad_axis(e, 0, bq2, 0)
+            valid = mesh_lib._pad_axis(valid, 0, bq2, False)
+            skew = mesh_lib._pad_axis(skew, 0, bq2, _BIG)
+            mindom = mesh_lib._pad_axis(mindom, 0, bq2, 0)
+            selfm = mesh_lib._pad_axis(selfm, 0, bq2, False)
+        runner = _bracket_runner(c_eff, dh, mesh)
         lo, hi, lp = runner(free, req, pods_free, gate,
                             dom, e, valid, skew, mindom, selfm)
         lo, hi, lp = np.asarray(lo), np.asarray(hi), np.asarray(lp)
@@ -368,11 +423,17 @@ def bracket_device(pbs: Sequence[enc.EncodedProblem]) -> List[CapacityBracket]:
 
 
 @functools.lru_cache(maxsize=8)
-def _auction_runner(rounds: int):
+def _auction_runner(rounds: int, mesh=None):
     """Jitted K-round FFD/auction: templates scan in order against the
     shared free matrix, each round claiming ceil(claimable / rounds-left)
     per node — round-robin fairness across the mix, everything claimable by
-    the last round.  Static on the round count."""
+    the last round.  Static on the round count.
+
+    With a mesh the shared free matrix shards over the "nodes" axis (there
+    is no scenario batch: every template bids against ONE snapshot), so the
+    per-template claim totals are cross-shard psums; inputs must be padded
+    to the node-shard multiple (`auction_device` pads with gate-False
+    zero-headroom nodes, which never win a claim)."""
     import jax
     import jax.numpy as jnp
 
@@ -406,7 +467,19 @@ def _auction_runner(rounds: int):
             0, rounds, round_body, (free, pods_free, zero))
         return claimed
 
-    return jax.jit(run)
+    if mesh is None:
+        return jax.jit(run)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from ..parallel.mesh import NODE_AXIS
+
+    def s(*parts):
+        return NamedSharding(mesh, P(*parts))
+
+    in_sh = (s(NODE_AXIS, None),             # free [N, R]
+             s(NODE_AXIS),                   # pods_free [N]
+             s(None, None),                  # reqs [T, R]
+             s(None, NODE_AXIS))             # gates [T, N]
+    return jax.jit(run, in_shardings=in_sh, out_shardings=s(None))
 
 
 def _mix_arrays(pbs: Sequence[enc.EncodedProblem]):
@@ -428,12 +501,19 @@ def _mix_arrays(pbs: Sequence[enc.EncodedProblem]):
 
 
 def auction_device(pbs: Sequence[enc.EncodedProblem],
-                   rounds: int = 4) -> List[int]:
+                   rounds: int = 4, *, mesh=None) -> List[int]:
     """K-round auction on device: per-template constructive claims against
     the SHARED free matrix (templates must encode the same snapshot).
     Dispatch-set member (GD001) — `bracket_mix` is the guarded entry."""
     free, pods_free, reqs, gates = _mix_arrays(pbs)
-    claimed = np.asarray(_auction_runner(int(rounds))(
+    if mesh is not None:
+        from ..parallel import mesh as mesh_lib
+        nn = int(mesh.shape[mesh_lib.NODE_AXIS])
+        n2 = -(-free.shape[0] // nn) * nn
+        free = mesh_lib._pad_axis(free, 0, n2, 0)
+        pods_free = mesh_lib._pad_axis(pods_free, 0, n2, 0)
+        gates = mesh_lib._pad_axis(gates, 1, n2, False)
+    claimed = np.asarray(_auction_runner(int(rounds), mesh)(
         free, pods_free, reqs, gates))
     return [int(c) for c in claimed]
 
@@ -480,14 +560,17 @@ def _validate_brackets(brs: Sequence[CapacityBracket], *, site: str) -> None:
 
 
 def bracket_group(pbs: Sequence[enc.EncodedProblem], *,
-                  parity: bool = True
+                  parity: bool = True, mesh=None
                   ) -> Tuple[List[CapacityBracket], bool]:
     """Guarded batched bracketing: one device shot under guard.run at
     faults.SITE_BOUNDS, validated, then parity-checked against the host
     recomputation (pruning decisions must never ride a silently-wrong
     kernel).  Any classified fault — or a parity mismatch, raised as
     NumericCorruption — degrades to the host brackets, which share the
-    formulas exactly.  Returns (brackets, degraded)."""
+    formulas exactly.  With a mesh the shot shards over (batch, nodes) —
+    the parity check applies unchanged, so a sharded bracket is held to the
+    same bit-match bar as an unsharded one.  Returns (brackets, degraded)."""
+    from ..parallel import mesh as mesh_lib
     from ..runtime import faults, guard
     from ..runtime.degrade import _record
     from ..runtime.errors import NumericCorruption, RuntimeFault
@@ -497,9 +580,10 @@ def bracket_group(pbs: Sequence[enc.EncodedProblem], *,
         return [], False
     try:
         try:
-            brs = guard.run(lambda: bracket_device(pbs),
+            brs = guard.run(lambda: bracket_device(pbs, mesh=mesh),
                             site=faults.SITE_BOUNDS, rung="bounds",
-                            batch=len(pbs))
+                            batch=len(pbs),
+                            mesh_shape=mesh_lib.mesh_shape(mesh))
             _validate_brackets(brs, site=faults.SITE_BOUNDS)
             if parity:
                 host = [bracket_host(pb) for pb in pbs]
@@ -519,13 +603,14 @@ def bracket_group(pbs: Sequence[enc.EncodedProblem], *,
         return [bracket_host(pb) for pb in pbs], True
 
 
-def bracket_mix(pbs: Sequence[enc.EncodedProblem], rounds: int = 4
-                ) -> Tuple[CapacityBracket, List[int], bool]:
+def bracket_mix(pbs: Sequence[enc.EncodedProblem], rounds: int = 4, *,
+                mesh=None) -> Tuple[CapacityBracket, List[int], bool]:
     """Joint bracket for a template mix against ONE shared snapshot: the
     upper bound sums the per-template solo uppers (any joint schedule is
     dominated per template) capped by the pooled pod slots; the lower bound
     is the guarded K-round auction's total.  Returns (joint bracket,
     per-template claims, degraded)."""
+    from ..parallel import mesh as mesh_lib
     from ..runtime import faults, guard
     from ..runtime.degrade import _record
     from ..runtime.errors import RuntimeFault
@@ -535,9 +620,10 @@ def bracket_mix(pbs: Sequence[enc.EncodedProblem], rounds: int = 4
         return CapacityBracket(0, 0, exact=False), [], False
     degraded = False
     try:
-        claims = guard.run(lambda: auction_device(pbs, rounds),
+        claims = guard.run(lambda: auction_device(pbs, rounds, mesh=mesh),
                            site=faults.SITE_BOUNDS, rung="bounds",
-                           batch=len(pbs))
+                           batch=len(pbs),
+                           mesh_shape=mesh_lib.mesh_shape(mesh))
         if any(c < 0 for c in claims):
             from ..runtime.errors import NumericCorruption
             raise NumericCorruption("negative auction claim",

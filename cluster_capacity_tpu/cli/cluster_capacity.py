@@ -72,6 +72,14 @@ def build_parser(prog: str = "cluster-capacity") -> argparse.ArgumentParser:
                         "per-placement why-here plugin score contributions, "
                         "and the bottleneck analysis.  Surfaces in the "
                         "report's explain section (verbose/json/yaml).")
+    p.add_argument("--mesh", default="",
+                   help="Shard batched solves over a device mesh: BxN "
+                        "(batch x node shards, e.g. 2x4), 'auto' (best mesh "
+                        "over every visible device; single-device hosts "
+                        "stay unsharded), or 'none' (default — unsharded). "
+                        "Applies to multi-podspec sweeps and batchable "
+                        "single-pod runs; --explain and --interleave stay "
+                        "on the per-template path.")
     p.add_argument("--no-bounds", dest="no_bounds", action="store_true",
                    help="Disable bound-guided scan-budget right-sizing "
                         "(bounds/bracket.py): solves keep the full step "
@@ -224,6 +232,13 @@ def run(argv: Optional[List[str]] = None, prog: str = "cluster-capacity") -> int
 
     exclude = [s for s in args.exclude_nodes.split(",") if s]
 
+    from ..parallel.mesh import parse_mesh
+    try:
+        mesh = parse_mesh(args.mesh)
+    except ValueError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+
     if args.node_order == "zone-round-robin" and (
             not args.snapshot or args.snapshot.endswith(".npz")):
         print("Error: --node-order zone-round-robin requires a YAML/JSON "
@@ -294,7 +309,7 @@ def run(argv: Optional[List[str]] = None, prog: str = "cluster-capacity") -> int
             cc = ClusterCapacity(pods[0], max_limit=args.max_limit,
                                  profile=profile, exclude_nodes=exclude,
                                  explain=args.explain,
-                                 bounds=not args.no_bounds)
+                                 bounds=not args.no_bounds, mesh=mesh)
             snap, raw_objs, snap_opts = current_snapshot()
             if snap is not None:
                 cc.set_snapshot(snap, **snap_opts)
@@ -337,7 +352,7 @@ def run(argv: Optional[List[str]] = None, prog: str = "cluster-capacity") -> int
                                                  max_total=args.max_limit)
             else:
                 results = sweep(snapshot, pods, profile=profile,
-                                max_limit=args.max_limit,
+                                max_limit=args.max_limit, mesh=mesh,
                                 explain=args.explain,
                                 bounds=not args.no_bounds)
         reg = metrics_mod.default_registry

@@ -84,6 +84,12 @@ def build_parser(prog: str = "resilience") -> argparse.ArgumentParser:
                         "right-sizing (bounds/bracket.py): every scenario "
                         "runs an exact device solve even when its capacity "
                         "bracket already proves the row.")
+    p.add_argument("--mesh", default="",
+                   help="Shard the batched scenario solves (and bracket "
+                        "shots) over a device mesh: BxN (batch x node "
+                        "shards, e.g. 2x4), 'auto' (best mesh over every "
+                        "visible device; single-device hosts stay "
+                        "unsharded), or 'none' (default — unsharded).")
     p.add_argument("--verbose", action="store_true", help="Verbose mode")
     p.add_argument("-o", "--output", default="",
                    help="Output format. One of: json|yaml.")
@@ -219,6 +225,13 @@ def run(argv: Optional[List[str]] = None, prog: str = "resilience") -> int:
               file=sys.stderr)
         return 1
 
+    from ..parallel.mesh import parse_mesh
+    try:
+        mesh = parse_mesh(args.mesh)
+    except ValueError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+
     from ..runtime.errors import CheckpointCorruption
     import contextlib
     try:
@@ -227,7 +240,7 @@ def run(argv: Optional[List[str]] = None, prog: str = "resilience") -> int:
                 from ..obs import profile as obs_profile
                 stack.enter_context(obs_profile.capture(args.profile_out))
             report = analyze(snapshot, scenarios, probe, profile=profile,
-                             max_limit=args.max_limit,
+                             max_limit=args.max_limit, mesh=mesh,
                              dedup=not args.no_dedup,
                              journal=args.journal or None, resume=args.resume,
                              explain=args.explain, bounds=not args.no_bounds)

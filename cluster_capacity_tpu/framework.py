@@ -27,7 +27,8 @@ class ClusterCapacity:
                  profile: Optional[SchedulerProfile] = None,
                  exclude_nodes: Sequence[str] = (),
                  explain: bool = False,
-                 bounds: bool = True):
+                 bounds: bool = True,
+                 mesh=None):
         self.pod = pod
         self.max_limit = max_limit
         self.profile = profile or SchedulerProfile()
@@ -35,6 +36,11 @@ class ClusterCapacity:
         self.explain = explain
         # bound-guided scan budgets (bounds/bracket.py); False = --no-bounds
         self.bounds = bounds
+        # optional jax.sharding.Mesh (parallel/mesh.py): batchable solves
+        # shard the node table over it via the sharded ladder rung; explain
+        # and extender runs stay on the per-template path (attribution and
+        # extender callbacks are host-side products)
+        self.mesh = mesh
         self.snapshot: Optional[ClusterSnapshot] = None
         self._result: Optional[SolveResult] = None
         self._final_snapshot: Optional[ClusterSnapshot] = None
@@ -177,9 +183,17 @@ class ClusterCapacity:
                     max_limit=remaining, site=faults.SITE_EXTENDERS,
                     validate_nodes=problem.snapshot.num_nodes)
             else:
-                result = solve_one_guarded(problem, max_limit=remaining,
-                                           explain=self.explain,
-                                           bounds=self.bounds)
+                from .parallel import sweep as sweep_mod
+                if self.mesh is not None and not self.explain \
+                        and sweep_mod._batchable(problem):
+                    from .runtime.degrade import solve_group_guarded
+                    result = solve_group_guarded(
+                        [problem], max_limit=remaining, mesh=self.mesh,
+                        bounds=self.bounds)[0]
+                else:
+                    result = solve_one_guarded(problem, max_limit=remaining,
+                                               explain=self.explain,
+                                               bounds=self.bounds)
             cycle_results.append(result)
             placements.extend(result.placements)
             if result.fail_type != "Unschedulable" or not preempt_on:

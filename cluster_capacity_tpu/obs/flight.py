@@ -62,6 +62,7 @@ SITE_TO_ENTRY = {
     "parallel.solve_group": "solve_group/n8b3",
     "engine.extenders": "extenders/n8",
     "bounds.bracket": "bounds_bracket/n8b3",
+    "parallel.sharded": "sharded_group/n8b2",
 }
 
 # fault code -> injection kind producing the same code through the real

@@ -20,6 +20,7 @@ Sharding layout ("nodes" = model/tensor axis, "batch" = data axis):
 
 from __future__ import annotations
 
+import re
 from typing import Dict, Optional, Sequence
 
 import numpy as np
@@ -43,6 +44,49 @@ def make_mesh(n_node_shards: Optional[int] = None, n_batch_shards: int = 1,
                          f"devices, have {len(devs)}")
     grid = np.asarray(devs[:used]).reshape(n_batch_shards, n_node_shards)
     return Mesh(grid, (BATCH_AXIS, NODE_AXIS))
+
+
+def auto_mesh(min_devices: int = 2):
+    """Best (batch, nodes) mesh over every visible device, or None when the
+    host exposes fewer than `min_devices` — single-device runs stay on the
+    unsharded path (the mesh machinery would only add dispatch overhead).
+    An even device count splits 2 × n/2 (scenario batches are plentiful in
+    resilience sweeps, node tables are the big tensors); odd counts put
+    everything on the node axis."""
+    import jax
+
+    devs = jax.devices()
+    n = len(devs)
+    if n < min_devices:
+        return None
+    n_batch = 2 if n % 2 == 0 else 1
+    return make_mesh(n_node_shards=n // n_batch, n_batch_shards=n_batch,
+                     devices=devs)
+
+
+def parse_mesh(text: Optional[str]):
+    """CLI `--mesh` values: '' / 'none' / 'off' → None, 'auto' →
+    auto_mesh(), 'BxN' → make_mesh(n_batch_shards=B, n_node_shards=N)."""
+    t = (text or "").strip().lower()
+    if t in ("", "none", "off"):
+        return None
+    if t == "auto":
+        return auto_mesh()
+    m = re.fullmatch(r"(\d+)x(\d+)", t)
+    if not m:
+        raise ValueError(f"bad mesh spec {text!r}: expected BxN (batch x "
+                         f"node shards, e.g. 2x4), 'auto', or 'none'")
+    return make_mesh(n_node_shards=int(m.group(2)),
+                     n_batch_shards=int(m.group(1)))
+
+
+def mesh_shape(mesh) -> Optional[Dict[str, int]]:
+    """{'batch': B, 'nodes': N} — the telemetry form stamped on guard spans
+    and report envelopes (status.mesh).  None for the unsharded path."""
+    if mesh is None:
+        return None
+    return {str(a): int(s)
+            for a, s in zip(mesh.axis_names, mesh.devices.shape)}
 
 
 def consts_shardings(mesh, consts: Dict[str, "jax.Array"],
@@ -103,6 +147,101 @@ def carry_shardings(mesh, carry, batched: bool = False):
         stopped=spec(),
         next_start=spec(),
         rng=NamedSharding(mesh, P()) if not batched else spec(None),
+    )
+
+
+# Node-axis position per consts key in the PER-PROBLEM layout (a leading
+# batch axis shifts each by one).  Single source with consts_shardings'
+# classification above: every key with a node axis is listed here, so the
+# mesh pad below and the sharding specs can never disagree about which
+# dimension is the node table.
+_NODE_AXIS_OF = {
+    "allocatable": 0, "static_mask": 0, "volume_mask": 0, "taint_raw": 0,
+    "na_raw": 0, "il_score": 0, "ss_ignored": 0, "ipa_eanti_static": 0,
+    "ipa_static_pref": 0, "sh_missing": 0,
+    "sh_dom": 1, "sh_countable": 1, "sh_cnt_init": 1,
+    "ss_dom": 1, "ss_countable": 1, "ss_cnt_init": 1, "ss_node_existing": 1,
+    "ipa_dom": 1, "ipa_aff_scnt": 1, "ipa_anti_scnt": 1,
+    "ss_onehot": 2,
+}
+# Pad values that make an appended node row inert: domain maps get the
+# "no domain" sentinel (-1 ⇒ has_key False everywhere), missing/ignored
+# masks get True, everything else zeros (no capacity, static_mask False).
+_PAD_NEG = frozenset({"sh_dom", "ss_dom", "ipa_dom"})
+_PAD_ONE = frozenset({"sh_missing", "ss_ignored"})
+
+
+def _pad_axis(a: np.ndarray, axis: int, target: int, value) -> np.ndarray:
+    if a.shape[axis] == target:
+        return a
+    widths = [(0, 0)] * a.ndim
+    widths[axis] = (0, target - a.shape[axis])
+    return np.pad(a, widths, constant_values=value)
+
+
+def pad_for_mesh(mesh, stacked: Dict[str, np.ndarray], carry):
+    """Pad a stacked consts dict + batched carry (numpy, leading batch axis)
+    to the mesh's shard multiples — NamedShardings require every sharded
+    dimension to divide evenly.
+
+    The batch axis pads by duplicating the last template (its extra results
+    are simply never read back); the node axis pads with inert rows that are
+    statically infeasible, domainless and uncountable — behaviorally
+    identical to pre-existing infeasible nodes, including the rotating
+    sample-window arithmetic (the wrap passes the pad region exactly as it
+    passes trailing infeasible nodes, so next_start trajectories match the
+    unpadded solve bit-for-bit)."""
+    nb = int(mesh.shape[BATCH_AXIS])
+    nn = int(mesh.shape[NODE_AXIS])
+    b, n = carry.placed.shape[0], carry.placed.shape[1]
+    b_pad = -(-b // nb) * nb
+    n_pad = -(-n // nn) * nn
+    if b_pad != b:
+        def rep(a):
+            return np.concatenate([a] + [a[-1:]] * (b_pad - b), axis=0)
+        stacked = {k: rep(v) for k, v in stacked.items()}
+        carry = type(carry)(*[rep(x) for x in carry])
+    if n_pad != n:
+        out = {}
+        for k, v in stacked.items():
+            ax = _NODE_AXIS_OF.get(k)
+            if ax is None:
+                out[k] = v
+            else:
+                val = -1 if k in _PAD_NEG else (1 if k in _PAD_ONE else 0)
+                out[k] = _pad_axis(v, ax + 1, n_pad, val)
+        stacked = out
+        carry = carry._replace(
+            requested=_pad_axis(carry.requested, 1, n_pad, 0),
+            nonzero=_pad_axis(carry.nonzero, 1, n_pad, 0),
+            placed=_pad_axis(carry.placed, 1, n_pad, 0),
+            sh_cnt=_pad_axis(carry.sh_cnt, 2, n_pad, 0),
+            ss_cnt=_pad_axis(carry.ss_cnt, 2, n_pad, 0),
+            aff_cnt=_pad_axis(carry.aff_cnt, 2, n_pad, 0),
+            anti_cnt=_pad_axis(carry.anti_cnt, 2, n_pad, 0),
+            pref_cnt=_pad_axis(carry.pref_cnt, 2, n_pad, 0),
+        )
+    return stacked, carry
+
+
+def unpad_carry(carry, n_nodes: int):
+    """Slice the padded node axes back off a batched carry so host-side
+    consumers (diagnose, explain) see the real node table.  Batch-axis pads
+    are left in place — callers never index past the real batch."""
+    return type(carry)(
+        requested=carry.requested[:, :n_nodes, :],
+        nonzero=carry.nonzero[:, :n_nodes, :],
+        placed=carry.placed[:, :n_nodes],
+        sh_cnt=carry.sh_cnt[:, :, :n_nodes],
+        ss_cnt=carry.ss_cnt[:, :, :n_nodes],
+        aff_cnt=carry.aff_cnt[:, :, :n_nodes],
+        anti_cnt=carry.anti_cnt[:, :, :n_nodes],
+        pref_cnt=carry.pref_cnt[:, :, :n_nodes],
+        aff_total=carry.aff_total,
+        placed_count=carry.placed_count,
+        stopped=carry.stopped,
+        next_start=carry.next_start,
+        rng=carry.rng,
     )
 
 

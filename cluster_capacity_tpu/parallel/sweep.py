@@ -425,19 +425,30 @@ def _batched_solve(pbs: List[enc.EncodedProblem], max_limit: int,
     # UNMAPPED — no B-way host stack, no B-way transfer, no B-way read per
     # step.  Only genuinely per-template arrays stack.  (The mesh path keeps
     # the full stacked layout: shard_consts shards the batch axis.)
+    n_nodes = pbs[0].snapshot.num_nodes
     shared: Dict[str, "jax.Array"] = {}
-    stacked: Dict[str, "jax.Array"] = {}
-    for k in consts_list[0]:
-        arrs = [c[k] for c in consts_list]
-        if mesh is None and _group_uniform(arrs):
-            shared[k] = jnp.asarray(arrs[0])
-        else:
-            stacked[k] = jnp.asarray(np.stack(arrs))
-    carry = jax.tree.map(lambda *xs: jnp.asarray(np.stack(xs)), *carry_list)
-
     if mesh is not None:
-        stacked = mesh_lib.shard_consts(mesh, stacked, batched=True)
-        carry = mesh_lib.shard_carry(mesh, carry, batched=True)
+        # full stacked layout, padded to the mesh's shard multiples (batch:
+        # duplicate templates, node: inert infeasible rows), then ONE
+        # sharded device_put per key — XLA's partitioner owns the layout
+        # from here and the scan never gathers a node table to one device.
+        stacked_np = {k: np.stack([c[k] for c in consts_list])
+                      for k in consts_list[0]}
+        carry_np = jax.tree.map(lambda *xs: np.stack(xs), *carry_list)
+        stacked_np, carry_np = mesh_lib.pad_for_mesh(mesh, stacked_np,
+                                                     carry_np)
+        stacked = mesh_lib.shard_consts(mesh, stacked_np, batched=True)
+        carry = mesh_lib.shard_carry(mesh, carry_np, batched=True)
+    else:
+        stacked = {}
+        for k in consts_list[0]:
+            arrs = [c[k] for c in consts_list]
+            if _group_uniform(arrs):
+                shared[k] = jnp.asarray(arrs[0])
+            else:
+                stacked[k] = jnp.asarray(np.stack(arrs))
+        carry = jax.tree.map(lambda *xs: jnp.asarray(np.stack(xs)),
+                             *carry_list)
     consts = (shared, stacked)
 
     if bounds:
@@ -455,7 +466,10 @@ def _batched_solve(pbs: List[enc.EncodedProblem], max_limit: int,
         budget = min(max_limit, budget)
     budget = max(1, min(budget, sim._DEFAULT_UNLIMITED_CAP))
 
-    run_chunk = _batched_chunk_runner()
+    if mesh is not None:
+        run_chunk = _batched_chunk_runner_sharded(mesh, consts, carry)
+    else:
+        run_chunk = _batched_chunk_runner()
 
     # The batched fused kernel runs whole chunks for the whole group in one
     # Pallas call (grid over templates, per-template scalars from SMEM) when
@@ -501,7 +515,11 @@ def _batched_solve(pbs: List[enc.EncodedProblem], max_limit: int,
     if max_limit and max_limit > 0:
         placements = [p[:max_limit] for p in placements]
 
-    explain = explain and mesh is None   # sharded carries stay distributed
+    explain = explain and mesh is None   # attribution is a per-template
+    if mesh is not None:
+        # slice the node-axis pads back off before any host-side consumer
+        # (diagnose reads the carry against the UNPADDED host consts)
+        carry = mesh_lib.unpad_carry(carry, n_nodes)
     if bstate is not None:
         # Unpack the packed planes (a [B, P, S*128] device->host round trip)
         # only when some template actually stopped short of its limit and
@@ -869,4 +887,56 @@ def _batched_chunk_runner():
             return new_c, chosen
         return jax.lax.scan(body, carry, None, length=n)
 
+    return run_chunk
+
+
+# Compiled sharded runners, keyed on (mesh, shared keys, stacked keys): the
+# in/out sharding pytrees depend on which consts the group carries, so the
+# jit wrapper is built per key-set and reused — an alive-mask change on a
+# fixed mesh hits the same wrapper AND the same executable (shapes, specs
+# and StaticConfig all match; tests/test_multichip.py pins zero recompiles).
+_SHARDED_RUNNERS: Dict[tuple, object] = {}
+
+
+def _batched_chunk_runner_sharded(mesh, consts, carry):
+    """Mesh-sharded chunk runner: the same vmapped scan step, dispatched
+    under jax.jit with explicit `in_shardings` from consts_shardings /
+    carry_shardings (batch axis over templates/scenarios, node axis over the
+    node tables) and the carry buffer donated — the scan updates the carried
+    per-node count planes in place across chunks.  The step's reductions
+    (min over countable nodes, global argmax over scores, per-domain spread
+    folds) cross the node axis, so GSPMD lowers them to collectives over the
+    mesh instead of gathering node tables to one device; the irgate contract
+    (IC007) pins that no full node-table all_gather survives lowering."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    shared, stacked = consts
+    key = (mesh, tuple(sorted(shared)), tuple(sorted(stacked)))
+    fn = _SHARDED_RUNNERS.get(key)
+    if fn is not None:
+        return fn
+
+    rep = NamedSharding(mesh, P())
+    in_sh = (
+        ({k: rep for k in shared},
+         mesh_lib.consts_shardings(mesh, stacked, batched=True)),
+        mesh_lib.carry_shardings(mesh, carry, batched=True),
+    )
+    # chosen stacks to [n_steps, B]: steps replicated, templates on batch
+    out_sh = (in_sh[1], NamedSharding(mesh, P(None, mesh_lib.BATCH_AXIS)))
+
+    @functools.partial(jax.jit, static_argnames=("cfg", "n"),
+                       in_shardings=in_sh, out_shardings=out_sh,
+                       donate_argnames=("carry",))
+    def run_chunk(cfg, consts, carry, n: int):
+        shared, stacked = consts
+
+        def body(c, _):
+            new_c, chosen = jax.vmap(
+                lambda st, cc: sim._step(cfg, {**shared, **st}, cc))(stacked, c)
+            return new_c, chosen
+        return jax.lax.scan(body, carry, None, length=n)
+
+    _SHARDED_RUNNERS[key] = run_chunk
     return run_chunk

@@ -33,6 +33,7 @@ from ..engine import simulator as sim
 from ..models import snapshot as snapshot_mod
 from ..models.snapshot import ClusterSnapshot
 from ..ops.priority_sort import sort_pods
+from ..parallel import mesh as mesh_shape_mod
 from ..parallel import sweep
 from ..utils.config import SchedulerProfile
 from .scenarios import FailureScenario, dedup_single_node
@@ -138,6 +139,10 @@ class SurvivabilityReport:
     # without a device solve — {"lower", "upper", "pruned"}; None when the
     # sweep ran with bounds disabled
     bounds: Optional[dict] = None
+    # device mesh the batched solves (and bracket shots) sharded over —
+    # {"batch": B, "nodes": N} (parallel/mesh.mesh_shape); None when the
+    # sweep ran unsharded
+    mesh: Optional[dict] = None
 
     @property
     def min_k_to_stranded(self) -> Optional[int]:
@@ -199,6 +204,7 @@ class SurvivabilityReport:
                 "worstRung": self.worst_rung,
                 "baselineBottleneck": self.baseline_bottleneck,
                 "bounds": self.bounds,
+                "mesh": self.mesh,
                 "worstNodes": [
                     {"nodeName": nm, "headroom": h, "stranded": s}
                     for nm, h, s in self.worst_nodes()],
@@ -223,6 +229,7 @@ class SurvivabilityReport:
             sequential_scenarios=status["sequentialScenarios"],
             baseline_bottleneck=status.get("baselineBottleneck"),
             bounds=status.get("bounds"),
+            mesh=status.get("mesh"),
         )
 
 
@@ -567,7 +574,7 @@ def analyze(snapshot: ClusterSnapshot, scenarios: Sequence[FailureScenario],
             # row additionally requires the host terminal diagnosis so its
             # fail message is the one the scan would have produced.
             from .. import bounds as bounds_mod
-            brackets, br_deg = bounds_mod.bracket_group(batch_pbs)
+            brackets, br_deg = bounds_mod.bracket_group(batch_pbs, mesh=mesh)
             kept_pbs: List[enc.EncodedProblem] = []
             kept_sis: List[int] = []
             for pb_s, br, si in zip(batch_pbs, brackets, batch_sis):
@@ -665,4 +672,5 @@ def analyze(snapshot: ClusterSnapshot, scenarios: Sequence[FailureScenario],
         sequential_scenarios=sum(1 for r in reps if not r.batched),
         baseline_bottleneck=base_bn,
         bounds=report_bounds,
+        mesh=mesh_shape_mod.mesh_shape(mesh),
     )

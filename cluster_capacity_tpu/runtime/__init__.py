@@ -13,7 +13,8 @@ gracefully, or resume — never crash with a raw traceback:
 - guard.py    the watchdog: wall-clock deadline + XlaRuntimeError
               classification + output validation around a device call
 - degrade.py  bounded retry with geometric batch splitting on OOM and the
-              degradation ladder fused_batched → fused → fast_path → oracle
+              degradation ladder sharded_batched → fused_batched → fused →
+              fast_path → oracle
 - faults.py   deterministic fault injection (env/config driven) shared by
               the chaos tests and the CLI --inject-fault flag
 """
@@ -22,12 +23,12 @@ from .errors import (CheckpointCorruption, CompileTimeout, DeviceOOM,
                      ExecuteTimeout, NumericCorruption, RuntimeFault,
                      SnapshotValidationError)
 from .degrade import (LADDER, RUNG_BATCHED, RUNG_FAST_PATH, RUNG_FUSED,
-                      RUNG_ORACLE, solve_group_guarded, solve_one_guarded,
-                      worst_rung)
+                      RUNG_ORACLE, RUNG_SHARDED, solve_group_guarded,
+                      solve_one_guarded, worst_rung)
 
 __all__ = [
     "RuntimeFault", "DeviceOOM", "CompileTimeout", "ExecuteTimeout",
     "NumericCorruption", "SnapshotValidationError", "CheckpointCorruption",
-    "LADDER", "RUNG_BATCHED", "RUNG_FUSED", "RUNG_FAST_PATH", "RUNG_ORACLE",
-    "solve_one_guarded", "solve_group_guarded", "worst_rung",
+    "LADDER", "RUNG_SHARDED", "RUNG_BATCHED", "RUNG_FUSED", "RUNG_FAST_PATH",
+    "RUNG_ORACLE", "solve_one_guarded", "solve_group_guarded", "worst_rung",
 ]

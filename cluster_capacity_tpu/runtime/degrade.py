@@ -2,6 +2,10 @@
 
 Every hardened solve descends a fixed ladder until a rung serves:
 
+    sharded_batched  the batched group solve dispatched over a (batch,
+                     nodes) device mesh (only entered when a mesh is
+                     selected; any classified fault falls back to the
+                     single-device batched rung below)
     fused_batched  one batched device solve for the whole [B, ...] group
     fused          the full engine per problem (fast path when exact,
                    fused-Pallas/XLA scan otherwise — sim.solve semantics)
@@ -30,15 +34,18 @@ from typing import List, Optional
 
 from . import guard
 from .errors import RuntimeFault
-from .faults import SITE_FAST_PATH, SITE_GROUP, SITE_ORACLE, SITE_SOLVE
+from .faults import (SITE_FAST_PATH, SITE_GROUP, SITE_ORACLE, SITE_SHARDED,
+                     SITE_SOLVE)
 
+RUNG_SHARDED = "sharded_batched"
 RUNG_BATCHED = "fused_batched"
 RUNG_FUSED = "fused"
 RUNG_FAST_PATH = "fast_path"
 RUNG_ORACLE = "oracle"
 
 # Ladder order, highest (healthiest) first.
-LADDER = (RUNG_BATCHED, RUNG_FUSED, RUNG_FAST_PATH, RUNG_ORACLE)
+LADDER = (RUNG_SHARDED, RUNG_BATCHED, RUNG_FUSED, RUNG_FAST_PATH,
+          RUNG_ORACLE)
 
 EVENT_DEGRADED = "SolveDegraded"
 
@@ -224,17 +231,42 @@ def solve_group_guarded(pbs, max_limit: int = 0, mesh=None, *,
                         deadline: float = 0.0, retries: int = 0,
                         degraded: bool = False,
                         explain: bool = False, bounds: bool = True) -> List:
-    """Hardened batched group solve.  DeviceOOM splits the group in half
-    geometrically (independent sub-batches, bit-identical placements) down
-    to B=1; other faults — and B=1 OOM — descend to the per-item ladder."""
+    """Hardened batched group solve.  With a mesh, the sharded rung runs
+    first (site parallel.sharded); any classified fault there falls back to
+    the single-device batched path — same numbers, one device.  DeviceOOM
+    on the unsharded rung splits the group in half geometrically
+    (independent sub-batches, bit-identical placements) down to B=1; other
+    faults — and B=1 OOM — descend to the per-item ladder."""
+    from ..parallel import mesh as mesh_lib
     from ..parallel import sweep as sweep_mod
     from .. import obs
 
     if not pbs:
         return []
     n = pbs[0].snapshot.num_nodes
+    shape = mesh_lib.mesh_shape(mesh)
 
-    with obs.span("degrade.solve_group", batch=len(pbs)):
+    with obs.span("degrade.solve_group", batch=len(pbs),
+                  **({"mesh_shape": shape} if shape else {})):
+        if mesh is not None:
+            try:
+                results = guard.run(
+                    lambda: sweep_mod.solve_group(pbs, max_limit=max_limit,
+                                                  mesh=mesh,
+                                                  explain=explain,
+                                                  bounds=bounds),
+                    site=SITE_SHARDED, deadline=deadline,
+                    phase=guard.PHASE_COMPILE, validate_nodes=n,
+                    rung=RUNG_SHARDED, batch=len(pbs), mesh_shape=shape)
+                return [_stamp(r, RUNG_SHARDED, degraded) for r in results]
+            except RuntimeFault as fault:
+                # the sharded rung's fallback is the UNSHARDED batched path
+                # (bit-identical by the sharding parity suite), so a mesh
+                # fault costs throughput, never different numbers
+                _record(fault, RUNG_BATCHED)
+                mesh = None
+                degraded = True
+
         last: Optional[RuntimeFault] = None
         for _ in range(retries + 1):
             try:

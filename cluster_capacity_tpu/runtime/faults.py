@@ -53,8 +53,9 @@ SITE_GROUP = "parallel.solve_group"
 SITE_EXTENDERS = "engine.extenders"
 SITE_INTERLEAVE = "parallel.interleave"
 SITE_BOUNDS = "bounds.bracket"
+SITE_SHARDED = "parallel.sharded"
 SITES = (SITE_SOLVE, SITE_FAST_PATH, SITE_ORACLE, SITE_GROUP,
-         SITE_EXTENDERS, SITE_INTERLEAVE, SITE_BOUNDS)
+         SITE_EXTENDERS, SITE_INTERLEAVE, SITE_BOUNDS, SITE_SHARDED)
 
 
 class SimulatedHang(Exception):

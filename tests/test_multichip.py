@@ -1,0 +1,217 @@
+"""Mesh-sharded fleet sweeps: differential fuzz of the sharded batched solve
+(parallel/sweep + parallel/mesh pjit path) against the single-device path —
+alive-mask changes, bounds on/off, uneven node/batch counts (padding to the
+shard multiples), zero-recompile on a fixed mesh, the sharded→batched
+degradation rung, and the mesh stamps on report envelopes and guard spans."""
+
+import jax
+import numpy as np
+import pytest
+
+from helpers import build_test_node, build_test_pod
+
+needs_8 = pytest.mark.skipif(len(jax.devices()) < 8,
+                             reason="needs 8 virtual devices")
+
+
+def _mesh():
+    from cluster_capacity_tpu.parallel import mesh as mesh_lib
+    return mesh_lib.make_mesh(n_node_shards=4, n_batch_shards=2)
+
+
+def _snapshot(n_nodes: int, seed: int = 0):
+    from cluster_capacity_tpu.models.snapshot import ClusterSnapshot
+    rng = np.random.RandomState(seed)
+    nodes = [build_test_node(
+        f"n{i:03d}", int(rng.choice([2000, 4000, 8000])),
+        int(rng.choice([8, 16])) * 1024 ** 3, 30,
+        labels={"kubernetes.io/hostname": f"n{i:03d}",
+                "topology.kubernetes.io/zone": f"z{i % 3}"})
+        for i in range(n_nodes)]
+    return ClusterSnapshot.from_objects(nodes)
+
+
+def _probe(spread: bool = False, name: str = "probe"):
+    from cluster_capacity_tpu.models.podspec import default_pod
+    pod = build_test_pod(name, 300, 512 * 1024 ** 2, labels={"app": name})
+    if spread:
+        pod["spec"]["topologySpreadConstraints"] = [{
+            "maxSkew": 2, "topologyKey": "topology.kubernetes.io/zone",
+            "whenUnsatisfiable": "DoNotSchedule",
+            "labelSelector": {"matchLabels": {"app": name}}}]
+    return default_pod(pod)
+
+
+def _masked_problems(snapshot, probe, masks):
+    from cluster_capacity_tpu import SchedulerProfile
+    from cluster_capacity_tpu.engine import encode as enc
+    profile = SchedulerProfile.parity()
+    return [enc.encode_problem(snapshot, probe, profile, alive_mask=m)
+            for m in masks]
+
+
+def _random_masks(rng, n_nodes: int, count: int):
+    masks = []
+    for _ in range(count):
+        m = np.ones(n_nodes, dtype=bool)
+        dead = rng.choice(n_nodes, size=rng.randint(0, 4), replace=False)
+        m[dead] = False
+        masks.append(m)
+    return masks
+
+
+@needs_8
+@pytest.mark.parametrize("n_nodes,spread", [(21, False), (37, True)])
+def test_sharded_masked_group_fuzz(n_nodes, spread):
+    """Differential fuzz: sharded == unsharded bit-identity across random
+    alive masks, bounds on/off, with node counts (21, 37) that do NOT
+    divide the 4 node shards and batch sizes (3) that do not divide the 2
+    batch shards — the pad-to-multiple path is always exercised."""
+    from cluster_capacity_tpu.parallel.sweep import solve_group
+
+    snapshot = _snapshot(n_nodes, seed=n_nodes)
+    probe = _probe(spread=spread)
+    rng = np.random.RandomState(7)
+    mesh = _mesh()
+    for trial in range(2):
+        masks = _random_masks(rng, n_nodes, count=3)
+        for bounds in (True, False):
+            pbs = _masked_problems(snapshot, probe, masks)
+            plain = solve_group(pbs, max_limit=24, bounds=bounds)
+            pbs = _masked_problems(snapshot, probe, masks)
+            shard = solve_group(pbs, max_limit=24, mesh=mesh, bounds=bounds)
+            for a, b in zip(plain, shard):
+                key = (trial, bounds)
+                assert a.placements == b.placements, key
+                assert a.placed_count == b.placed_count, key
+                assert a.fail_type == b.fail_type, key
+                assert a.fail_message == b.fail_message, key
+
+
+@needs_8
+def test_zero_recompile_across_alive_masks():
+    """A fixed mesh compiles the sharded runner ONCE: changing which nodes
+    are alive between solves must not retrace (the mask rides the packed
+    static planes as data, and the runner cache keys on mesh + consts
+    keys, not on values)."""
+    from cluster_capacity_tpu import obs
+    from cluster_capacity_tpu.obs import names as obs_names
+    from cluster_capacity_tpu.parallel.sweep import solve_group
+    from cluster_capacity_tpu.utils.metrics import default_registry
+
+    snapshot = _snapshot(24, seed=3)
+    probe = _probe()
+    mesh = _mesh()
+    rng = np.random.RandomState(11)
+    # warm: compile the sharded runner for this (mesh, consts-keys) shape
+    solve_group(_masked_problems(snapshot, probe,
+                                 _random_masks(rng, 24, 4)),
+                max_limit=16, mesh=mesh)
+    obs.install_recompile_hook()
+    before = default_registry.counter_total(obs_names.RECOMPILES)
+    for _ in range(3):
+        solve_group(_masked_problems(snapshot, probe,
+                                     _random_masks(rng, 24, 4)),
+                    max_limit=16, mesh=mesh)
+    after = default_registry.counter_total(obs_names.RECOMPILES)
+    assert after == before, f"{after - before} recompiles across alive masks"
+
+
+@needs_8
+def test_sharded_fault_degrades_to_batched():
+    """An injected fault at the sharded rung (site parallel.sharded) must
+    fall back to the single-device batched path with bit-identical results,
+    stamped rung=fused_batched and degraded=True."""
+    from cluster_capacity_tpu.runtime import degrade, faults
+
+    snapshot = _snapshot(16, seed=5)
+    probe = _probe()
+    masks = [np.ones(16, dtype=bool) for _ in range(3)]
+    for i, m in enumerate(masks):
+        m[i] = False
+    reference = degrade.solve_group_guarded(
+        _masked_problems(snapshot, probe, masks), max_limit=12)
+    with faults.inject("parallel.sharded:oom"):
+        res = degrade.solve_group_guarded(
+            _masked_problems(snapshot, probe, masks), max_limit=12,
+            mesh=_mesh())
+    for a, b in zip(reference, res):
+        assert b.degraded
+        assert b.rung == degrade.RUNG_BATCHED
+        assert a.placements == b.placements
+        assert a.fail_message == b.fail_message
+
+
+@needs_8
+def test_sharded_clean_run_stamps_sharded_rung():
+    from cluster_capacity_tpu.runtime import degrade
+
+    snapshot = _snapshot(16, seed=6)
+    probe = _probe()
+    masks = [np.ones(16, dtype=bool)]
+    res = degrade.solve_group_guarded(
+        _masked_problems(snapshot, probe, masks), max_limit=8, mesh=_mesh())
+    assert res[0].rung == degrade.RUNG_SHARDED
+    assert not res[0].degraded
+
+
+@needs_8
+def test_sharded_bracket_group_parity_uneven_nodes():
+    """Sharded bracket shots bit-match the unsharded ones (and therefore the
+    f64 host oracle bracket_group parity-checks against) on a node count
+    that does not divide the node shards."""
+    from cluster_capacity_tpu import bounds
+
+    snapshot = _snapshot(37, seed=9)
+    probe = _probe(spread=True)
+    masks = _random_masks(np.random.RandomState(2), 37, 3)
+    pbs = _masked_problems(snapshot, probe, masks)
+    plain, d0 = bounds.bracket_group(pbs)
+    shard, d1 = bounds.bracket_group(pbs, mesh=_mesh())
+    assert not d0 and not d1
+    for a, b in zip(plain, shard):
+        assert (a.lower, a.upper, a.exact, a.frac) == \
+               (b.lower, b.upper, b.exact, b.frac)
+
+
+@needs_8
+def test_analyzer_report_and_spans_carry_mesh():
+    """status.mesh rides the report envelope (and survives the dict
+    round-trip); the sharded guard spans carry mesh_shape + per-shard
+    batch attrs."""
+    from cluster_capacity_tpu import obs
+    from cluster_capacity_tpu.resilience.analyzer import (SurvivabilityReport,
+                                                          analyze)
+    from cluster_capacity_tpu.resilience.scenarios import \
+        single_node_scenarios
+    from cluster_capacity_tpu.runtime import faults
+
+    snapshot = _snapshot(12, seed=1)
+    probe = _probe()
+    report = analyze(snapshot, single_node_scenarios(snapshot), probe,
+                     max_limit=8, mesh=_mesh(), keep_placements=True)
+    assert report.mesh == {"batch": 2, "nodes": 4}
+    assert SurvivabilityReport.from_dict(report.to_dict()).mesh == report.mesh
+
+    sharded_spans = [sp for sp in obs.default_collector.spans()
+                     if sp.site == faults.SITE_SHARDED
+                     and sp.attrs.get("mesh_shape")]
+    assert sharded_spans, "no guard span recorded for the sharded rung"
+    sp = sharded_spans[-1]
+    assert sp.attrs["mesh_shape"] == {"batch": 2, "nodes": 4}
+    assert sp.attrs["per_shard_batch"] == -(-int(sp.batch) // 2)
+
+
+@needs_8
+def test_framework_single_pod_mesh_parity():
+    from cluster_capacity_tpu.framework import ClusterCapacity
+
+    snapshot = _snapshot(16, seed=4)
+    probe = _probe()
+    results = []
+    for mesh in (None, _mesh()):
+        cc = ClusterCapacity(probe, max_limit=20, mesh=mesh)
+        cc.set_snapshot(snapshot)
+        r = cc.run()
+        results.append((list(r.placements), r.fail_type, r.fail_message))
+    assert results[0] == results[1]

@@ -253,7 +253,8 @@ def _bench(**over):
 def test_perfgate_clean_on_pin_source():
     bench = _bench()
     pins = pg.make_pins(bench, "BENCH_r98.json")
-    assert set(pins["metrics"]) == {
+    assert set(pins["platforms"]) == {"cpu"}
+    assert set(pins["platforms"]["cpu"]["metrics"]) == {
         "scan_engine_spread_placements_per_sec_10000_nodes",
         "fast_path_placements_per_sec"}
     findings, skip = pg.compare(bench, pins)
@@ -296,6 +297,60 @@ def test_perfgate_platform_change_skips():
                                        fast_path_placements_per_sec=1.0),
                                 pins)
     assert findings == [] and "platform changed" in skip
+
+
+def test_perfgate_legacy_flat_pins_still_compare():
+    """The pre-platform-keyed pins layout (top-level platform/metrics)
+    normalizes into a one-slot platforms map on load/compare."""
+    legacy = {"platform": "cpu", "source": "BENCH_r98.json",
+              "tolerance_pct": 10.0,
+              "metrics": {"fast_path_placements_per_sec": 50000.0}}
+    findings, skip = pg.compare(_bench(), legacy)
+    assert skip is None
+    assert [(f.metric, f.rule) for f in findings] == [
+        ("scan_engine_spread_placements_per_sec_10000_nodes", "PG001")]
+
+
+def test_perfgate_repin_preserves_other_platform_slots():
+    """--update-pins on one platform must not clobber another platform's
+    floors (cpu numbers can never gate — or erase — a tpu pin)."""
+    cpu_pins = pg.make_pins(_bench(), "BENCH_r98.json")
+    cpu_pins["platforms"]["cpu"]["efficiency_floors"] = {"scan/n8": 0.01}
+    both = pg.make_pins(_bench(platform="tpu",
+                               fast_path_placements_per_sec=9e6),
+                        "BENCH_r99.json", prev=cpu_pins)
+    assert set(both["platforms"]) == {"cpu", "tpu"}
+    cpu_slot = both["platforms"]["cpu"]
+    assert cpu_slot["metrics"]["fast_path_placements_per_sec"] == 50000.0
+    assert cpu_slot["efficiency_floors"] == {"scan/n8": 0.01}
+    assert both["platforms"]["tpu"]["metrics"][
+        "fast_path_placements_per_sec"] == 9e6
+    # each platform gates only against its own slot
+    findings, skip = pg.compare(_bench(), both)
+    assert findings == [] and skip is None
+
+
+def test_perfgate_merge_rates_folds_multichip_metrics():
+    """The multichip sweep artifact's rate keys fold into the bench doc for
+    one compare/pin pass; workload descriptors (nodes, counts) do not."""
+    mdoc = {"ok": True, "skipped": False, "platform": "cpu",
+            "nodes": 2000, "scenarios": 2000,
+            "sharded_sweep_placements_per_sec": 3500.0,
+            "sharded_sweep_per_device_placements_per_sec": 437.5}
+    merged = pg.merge_rates(_bench(), mdoc)
+    pins = pg.make_pins(merged, "BENCH_r98.json")
+    metrics = pins["platforms"]["cpu"]["metrics"]
+    assert metrics["sharded_sweep_placements_per_sec"] == 3500.0
+    assert metrics["sharded_sweep_per_device_placements_per_sec"] == 437.5
+    assert "nodes" not in metrics
+    findings, skip = pg.compare(merged, pins)
+    assert findings == [] and skip is None
+    # the sharded sweep regressing trips PG002 like any bench metric
+    slow = pg.merge_rates(_bench(), dict(
+        mdoc, sharded_sweep_placements_per_sec=2000.0))
+    findings, _ = pg.compare(slow, pins)
+    assert [(f.metric, f.rule) for f in findings] == [
+        ("sharded_sweep_placements_per_sec", "PG002")]
 
 
 def test_perfgate_cli_exit_codes(tmp_path, capsys):

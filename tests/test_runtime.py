@@ -766,6 +766,15 @@ def _flight_drill(site):
                 snap, [_probe(200, name="a"), _probe(300, name="b")],
                 max_total=4)
         return drive, (), False
+    if site == "parallel.sharded":
+        from cluster_capacity_tpu.parallel import mesh as mesh_lib
+
+        def drive():
+            # degenerate 1x1 mesh: same sharded code path, any device count
+            degrade.solve_group_guarded(
+                _group_pbs(),
+                mesh=mesh_lib.make_mesh(n_node_shards=1, n_batch_shards=1))
+        return drive, (), False
     assert site == "bounds.bracket"
     from cluster_capacity_tpu import bounds
 

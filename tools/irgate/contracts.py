@@ -19,6 +19,13 @@ Rules (entry/computation context is attached by the caller):
          intermediates, sub-jaxpr bodies) may carry a dtype outside the
          entry's allowed set — the jaxpr-level generalization of IC002,
          catching f64 that arrives via transfer rather than a cast.
+- IC007  explicit gather collective (``all_gather`` / ``all_to_all``) in a
+         mesh-sharded entry: the sharded sweep keeps the node table
+         partitioned end-to-end and combines across shards with reductions
+         only; an all-gather materializes every shard's node rows on every
+         device, erasing the memory scaling the mesh exists for.  (GSPMD
+         reductions inserted at partitioning time lower to all-reduce and
+         never trip this.)
 
 StableHLO text checks back the jaxpr checks: IC001 also scans the lowered
 module for host-callback custom_call targets, and IC002/IC005 for ``f64``
@@ -41,9 +48,12 @@ RULES: Dict[str, str] = {
     "IC004": "donated-but-unused buffer",
     "IC005": "dtype outside the entry's allowed set",
     "IC006": "entry expected zero device dispatches",
+    "IC007": "gather collective in sharded entry (reductions only)",
 }
 
 _CALLBACK_MARKERS = ("callback", "infeed", "outfeed", "outside_call")
+_GATHER_MARKERS = ("all_gather", "all_to_all")
+_HLO_ALL_GATHER_RE = re.compile(r"\ball[-_]gather\b|\ball[-_]to[-_]all\b")
 _HLO_CALLBACK_RE = re.compile(
     r'custom_call[^\n]*call_target_name\s*=\s*"[^"]*callback[^"]*"')
 _HLO_F64_RE = re.compile(r"\btensor<(?:\d+x)*f64>|\bf64\b")
@@ -70,6 +80,7 @@ class Policy:
 
     forbid_f64: bool = True
     max_while: int = 0
+    forbid_gather: bool = False      # IC007: sharded entries, reductions only
     allowed_dtypes: Tuple[str, ...] = (
         "float32", "int32", "int8", "uint8", "uint32", "bool")
     check_dtype_flow: bool = True
@@ -105,6 +116,11 @@ def _check_jaxpr(entry: str, comp: str, closed_jaxpr,
                 f"host callback primitive `{name}` in lowered program"))
         if name == "while":
             while_count += 1
+        if policy.forbid_gather and any(m in name for m in _GATHER_MARKERS):
+            findings.append(IrFinding(
+                entry, comp, "IC007",
+                f"collective `{name}` replicates a sharded table across the "
+                f"mesh; cross-shard combines must be reductions"))
         if policy.forbid_f64 and name == "convert_element_type":
             new = eqn.params.get("new_dtype")
             if new is not None and "float64" in str(new):
@@ -181,6 +197,11 @@ def _check_stablehlo(entry: str, comp: str, hlo_text: str,
         findings.append(IrFinding(
             entry, comp, "IC002",
             "StableHLO module contains f64-typed values"))
+    if policy.forbid_gather and _HLO_ALL_GATHER_RE.search(hlo_text):
+        findings.append(IrFinding(
+            entry, comp, "IC007",
+            "StableHLO module contains an all-gather/all-to-all collective "
+            "(sharded entries combine across shards with reductions only)"))
     return findings
 
 

@@ -3,9 +3,11 @@
 Each EntrySpec names one engine entry point at one canonical abstract shape
 and owns a driver that exercises it under jit-capture.  The ladder mirrors
 the PR-4 degradation ladder (fused_batched → fused → fast_path → oracle)
-plus the scan engine, the batched group solve, the extender kernels and the
-preemption loop, so `python -m tools.irgate` covers every rung a production
-solve can land on.
+plus the scan engine, the batched group solve, the mesh-sharded group solve
+(on a degenerate 1x1 mesh — irgate is single-device CPU by contract, and the
+pjit lowering path is identical at any mesh size), the extender kernels and
+the preemption loop, so `python -m tools.irgate` covers every rung a
+production solve can land on.
 
 Fixtures are tiny (3–8 nodes) and CPU-only: the Pallas rungs run in
 interpret mode via ``CC_TPU_FUSED=1`` (the env knob fused.eligible() reads
@@ -156,6 +158,16 @@ def _drive_group(b: int):
     return driver
 
 
+def _drive_sharded_group(b: int):
+    def driver():
+        from cluster_capacity_tpu.parallel import mesh as mesh_lib
+        from cluster_capacity_tpu.parallel import sweep as sweep_mod
+        mesh = mesh_lib.make_mesh(n_node_shards=1, n_batch_shards=1)
+        pbs = [_problem(8) for _ in range(b)]
+        sweep_mod.solve_group(pbs, mesh=mesh)
+    return driver
+
+
 def _drive_fast_path(b: int):
     def driver():
         from cluster_capacity_tpu.engine import fast_path
@@ -256,6 +268,12 @@ def canonical_entries() -> List[EntrySpec]:
         EntrySpec("fused/n8", "fused", _drive_fused(), env=fused_on),
         EntrySpec("solve_group/n8b3", "fused_batched",
                   _drive_group(3), env=fused_off),
+        # mesh-sharded group solve: the pjit'd scan with in_shardings; the
+        # policy additionally forbids gather collectives (IC007) — the node
+        # table must stay partitioned, cross-shard combines are reductions
+        EntrySpec("sharded_group/n8b2", "sharded_batched",
+                  _drive_sharded_group(2), env=fused_off,
+                  policy=Policy(forbid_gather=True)),
         EntrySpec("scan/n8", "fused", _drive_scan(8), env=fused_off),
         EntrySpec("scan/n16", "fused", _drive_scan(16), env=fused_off),
         EntrySpec("fast_path/n8b3", "fast_path",

@@ -2,8 +2,11 @@
 
 Default run = compare the given bench artifact (default: the latest
 committed BENCH_r*.json, numerically sorted) against the committed
-throughput floors in tools/perfgate/pins.json.  Exit 0 = clean or
-skipped (platform change / no artifacts yet), 1 = findings.
+throughput floors in tools/perfgate/pins.json — the pins are platform-keyed
+(one slot per platform), and the rate keys of the latest MULTICHIP_r*.json
+(the mesh-sharded sweep bench) fold into the comparison when its platform
+matches.  Exit 0 = clean or skipped (unpinned platform / no artifacts
+yet), 1 = findings.
 
 Flags:
 
@@ -47,20 +50,39 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     bench_path = args.bench
+    fold_multichip = False
     if not bench_path:
         files = gate.bench_files()
         if not files:
             print("perfgate: skipped (no BENCH_r*.json artifacts yet)")
             return 0
         bench_path = files[-1]
+        # gating the committed artifacts (no explicit bench): also fold in
+        # the committed multichip sweep; an explicit bench argument gates
+        # exactly that artifact
+        fold_multichip = True
     bench = gate.load_bench(bench_path)
+    bench_label = os.path.basename(bench_path)
+
+    # fold in the latest mesh-sharded sweep bench (rate keys only) so its
+    # throughput floors ride the same pins file and tolerance band
+    mc_files = gate.multichip_files() if fold_multichip else []
+    if mc_files:
+        mdoc = gate.load_bench(mc_files[-1])
+        if mdoc.get("ok") and not mdoc.get("skipped") \
+                and mdoc.get("platform") == bench.get("platform"):
+            bench = gate.merge_rates(bench, mdoc)
+            bench_label += f" + {os.path.basename(mc_files[-1])}"
 
     if args.update_pins:
-        doc = gate.make_pins(bench, bench_path, tolerance_pct=args.tolerance,
+        doc = gate.make_pins(bench, bench_label,
+                             tolerance_pct=args.tolerance,
                              prev=gate.load_pins(args.pins))
+        platform = bench.get("platform", "unknown")
+        n = len(doc["platforms"][platform]["metrics"])
         gate.save_pins(doc, args.pins)
-        print(f"perfgate: pinned {len(doc['metrics'])} metric floor(s) "
-              f"from {os.path.basename(bench_path)} to "
+        print(f"perfgate: pinned {n} metric floor(s) for platform "
+              f"'{platform}' from {bench_label} to "
               f"{os.path.relpath(args.pins, gate.ROOT)}")
         return 0
 
@@ -69,10 +91,12 @@ def main(argv=None) -> int:
     info = []
     if args.calibration:
         with open(args.calibration, "r", encoding="utf-8") as fh:
-            info = gate.efficiency_findings(json.load(fh), pins)
+            info = gate.efficiency_findings(
+                json.load(fh), pins,
+                platform=bench.get("platform", "unknown"))
     doc = {
         "perfgate": 1,
-        "bench": os.path.basename(bench_path),
+        "bench": bench_label,
         "clean": not findings,
         "skipped": skip,
         "findings": [{"metric": f.metric, "rule": f.rule,
@@ -95,7 +119,7 @@ def main(argv=None) -> int:
             print(f"{f.render()} [informational]")
         if not skip:
             n = len(gate.gated_metrics(bench))
-            print(f"perfgate: {os.path.basename(bench_path)}: {n} gated "
+            print(f"perfgate: {bench_label}: {n} gated "
                   f"metric(s), {len(findings)} finding(s)"
                   + (f", {len(info)} informational" if info else ""))
     return 1 if findings else 0

@@ -20,9 +20,19 @@ Rules:
          the weather — the finding names the entry and ratio, the exit
          code ignores it
 
-A platform change (cpu pins vs a tpu run, or vice versa) is a *skip*, not
-a failure: floors are platform-specific by nature, exactly like the bench
-trend check.
+Pins are platform-keyed: ``pins.json`` holds a ``platforms`` map with one
+slot per platform (cpu, tpu, ...), each carrying its own source, metric
+floors and efficiency floors — CPU-fallback numbers can never gate a TPU
+run.  A bench from a platform with no pinned slot is a *skip*, not a
+failure (exactly like the bench trend check), and ``--update-pins``
+rewrites only the running platform's slot, leaving the others untouched.
+The legacy flat layout (a single top-level ``platform``/``metrics``) still
+loads, normalized into a one-slot map.
+
+The gate also folds in the latest ``MULTICHIP_r*.json`` artifact (the
+mesh-sharded sweep bench): its ``*_per_sec`` rate keys merge into the bench
+document before comparison, so the sharded-sweep throughput floors ride the
+same pins file and tolerance band.
 """
 
 from __future__ import annotations
@@ -58,6 +68,7 @@ _SCENARIO_PREFIXES = (
     ("interleave_", "interleave"),
     ("resilience_", "resilience"),
     ("bounds_", "bounds"),
+    ("sharded_sweep_", "sharded"),
 )
 
 
@@ -80,6 +91,27 @@ def bench_files(root: str = ROOT) -> List[str]:
         glob.glob(os.path.join(root, "BENCH_r*.json")),
         key=lambda p: (int(m.group(1)) if (m := re.search(
             r"BENCH_r(\d+)\.json$", p)) else -1, p))
+
+
+def multichip_files(root: str = ROOT) -> List[str]:
+    """Committed MULTICHIP_r*.json artifacts, numerically sorted."""
+    return sorted(
+        glob.glob(os.path.join(root, "MULTICHIP_r*.json")),
+        key=lambda p: (int(m.group(1)) if (m := re.search(
+            r"MULTICHIP_r(\d+)\.json$", p)) else -1, p))
+
+
+def merge_rates(bench: Dict[str, Any],
+                extra: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold another artifact's ``*_per_sec`` rate keys into a bench doc so
+    one compare/pin pass covers both (used for the multichip sweep bench;
+    only rate keys cross over, so workload descriptors never collide)."""
+    merged = dict(bench)
+    for k, v in (extra or {}).items():
+        if k.endswith("_per_sec") and isinstance(v, (int, float)) \
+                and not isinstance(v, bool):
+            merged[k] = float(v)
+    return merged
 
 
 def load_bench(path: str) -> Dict[str, Any]:
@@ -138,28 +170,55 @@ def _phase_note(bench: Dict[str, Any], metric: str) -> str:
     return "; phases[" + scenario_for(metric) + "]: " + ", ".join(parts)
 
 
+def _normalize_pins(doc: Optional[Dict[str, Any]]
+                    ) -> Optional[Dict[str, Any]]:
+    """Accept both pin layouts; return the platform-keyed one.  The legacy
+    flat layout (top-level platform/source/metrics) becomes a one-slot
+    ``platforms`` map."""
+    if doc is None or "platforms" in doc:
+        return doc
+    slot = {"source": doc.get("source", ""),
+            "metrics": dict(doc.get("metrics") or {})}
+    if isinstance(doc.get("efficiency_floors"), dict):
+        slot["efficiency_floors"] = dict(doc["efficiency_floors"])
+    return {
+        "_comment": doc.get("_comment", _HEADER),
+        "tolerance_pct": float(doc.get("tolerance_pct",
+                                       DEFAULT_TOLERANCE_PCT)),
+        "platforms": {doc.get("platform", "unknown"): slot},
+    }
+
+
 def load_pins(path: str = DEFAULT_PINS) -> Optional[Dict[str, Any]]:
     if not os.path.exists(path):
         return None
     with open(path, "r", encoding="utf-8") as fh:
-        return json.load(fh)
+        return _normalize_pins(json.load(fh))
 
 
 def make_pins(bench: Dict[str, Any], source: str,
               tolerance_pct: float = DEFAULT_TOLERANCE_PCT,
               prev: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
-    doc = {
-        "_comment": _HEADER,
-        "platform": bench.get("platform", "unknown"),
-        "source": os.path.basename(source),
-        "tolerance_pct": float(tolerance_pct),
-        "metrics": dict(sorted(gated_metrics(bench).items())),
-    }
+    """Pin this bench's metrics into its platform's slot; every other
+    platform slot in ``prev`` carries through untouched."""
+    prev = _normalize_pins(prev)
+    platform = bench.get("platform", "unknown")
+    platforms: Dict[str, Any] = {}
+    if prev and isinstance(prev.get("platforms"), dict):
+        platforms = {k: dict(v) for k, v in prev["platforms"].items()}
+    slot = {"source": os.path.basename(source),
+            "metrics": dict(sorted(gated_metrics(bench).items()))}
     # informational efficiency floors (PG004) are hand-curated, not derived
     # from a bench artifact — carry them through a re-pin untouched
-    if prev and isinstance(prev.get("efficiency_floors"), dict):
-        doc["efficiency_floors"] = dict(prev["efficiency_floors"])
-    return doc
+    prev_slot = platforms.get(platform) or {}
+    if isinstance(prev_slot.get("efficiency_floors"), dict):
+        slot["efficiency_floors"] = dict(prev_slot["efficiency_floors"])
+    platforms[platform] = slot
+    return {
+        "_comment": _HEADER,
+        "tolerance_pct": float(tolerance_pct),
+        "platforms": platforms,
+    }
 
 
 def save_pins(doc: Dict[str, Any], path: str = DEFAULT_PINS) -> None:
@@ -179,14 +238,17 @@ def compare(bench: Dict[str, Any], pins: Optional[Dict[str, Any]]
             "*", "PG000",
             "no committed pins.json — run `python -m tools.perfgate "
             "--update-pins` and commit the file")], None)
+    pins = _normalize_pins(pins)
     got_platform = bench.get("platform", "unknown")
-    pin_platform = pins.get("platform", "unknown")
-    if got_platform != pin_platform:
-        return ([], f"platform changed ({pin_platform} -> {got_platform}); "
+    slot = (pins.get("platforms") or {}).get(got_platform)
+    if slot is None:
+        pinned_plats = ", ".join(sorted(pins.get("platforms") or {})) \
+            or "none"
+        return ([], f"platform changed ({pinned_plats} -> {got_platform}); "
                     f"floors are platform-specific — re-pin with "
                     f"--update-pins on the new platform")
     tol = float(pins.get("tolerance_pct", DEFAULT_TOLERANCE_PCT))
-    pinned: Dict[str, float] = pins.get("metrics", {})
+    pinned: Dict[str, float] = slot.get("metrics", {})
     measured = gated_metrics(bench)
     findings: List[PerfFinding] = []
     for name in sorted(measured):
@@ -217,14 +279,19 @@ def compare(bench: Dict[str, Any], pins: Optional[Dict[str, Any]]
 
 
 def efficiency_findings(calibration: Optional[Dict[str, Any]],
-                        pins: Optional[Dict[str, Any]]
-                        ) -> List[PerfFinding]:
+                        pins: Optional[Dict[str, Any]],
+                        platform: Optional[str] = None) -> List[PerfFinding]:
     """PG004, informational only: calibrated kernel-efficiency ratios
     (obs/costmodel.py report, or a `hypercc profile` calibration.json)
-    vs the optional ``efficiency_floors`` map in pins.json.  The caller
+    vs the optional per-platform ``efficiency_floors`` pins.  The caller
     prints these but they NEVER affect the gate's exit code — efficiency
-    is measured on whatever host happened to run the calibration."""
-    floors = (pins or {}).get("efficiency_floors") or {}
+    is measured on whatever host happened to run the calibration.  With no
+    ``platform`` the floors of every pinned platform apply (union)."""
+    pins = _normalize_pins(pins)
+    slots = (pins or {}).get("platforms") or {}
+    floors: Dict[str, Any] = {}
+    for name in sorted(slots) if platform is None else [platform]:
+        floors.update((slots.get(name) or {}).get("efficiency_floors") or {})
     entries = (calibration or {}).get("entries") or {}
     out: List[PerfFinding] = []
     for name in sorted(entries):

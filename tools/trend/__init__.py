@@ -104,6 +104,14 @@ def collect(root: str = ROOT) -> dict:
         put("multichip_ok", rnd, bool(doc.get("ok")))
         if doc.get("n_devices"):
             put("multichip_devices", rnd, doc["n_devices"])
+        # fleet-sweep bench rounds (tools/multichip_bench.py) carry flat
+        # numeric keys — throughput rates, pruned/solved counts — that
+        # trend like bench metrics; envelope/status keys stay out
+        for k, v in doc.items():
+            if k in ("rc", "n_devices", "ok", "skipped") \
+                    or k in _NON_METRIC_KEYS:
+                continue
+            put(k, rnd, v)
 
     gates = {}
     for name, fname in (("irgate", "IRGATE.json"),
