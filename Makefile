@@ -7,7 +7,7 @@ CXX ?= g++
 CXXFLAGS ?= -O2 -std=c++17 -fPIC -Wall
 NATIVE_LIB := cluster_capacity_tpu/models/libccsnap.so
 
-.PHONY: all build native lint test-unit test-parity test-fuzz test-dist test-integration test-e2e bench multichip perfgate trend chaos profile-smoke clean verify-native ci
+.PHONY: all build native lint test-unit test-parity test-fuzz test-dist test-integration test-e2e bench multichip perfgate compilegate trend chaos profile-smoke clean verify-native ci
 
 all: build
 
@@ -84,6 +84,16 @@ multichip:
 # --update-pins` and review the diff).
 perfgate:
 	$(PY) -m tools.perfgate
+
+# Compile-budget gate (PG005): re-run the canonical irgate ladder entries
+# from a cold compile cache, tally backend-compile seconds per entry
+# (tools/perfgate/compilebudget.py), and gate against the compile_budgets
+# pinned in tools/perfgate/pins.json — plus the steady-recompile invariant
+# from the latest bench artifact.  Re-pin budgets with
+# `python -m tools.perfgate --update-pins --compile-budget`.
+compilegate:
+	JAX_PLATFORMS=cpu $(PY) -m tools.perfgate --compile-budget \
+		--json-out COMPILEGATE.json
 
 # Cross-round metric history: merge the committed BENCH_r*.json /
 # MULTICHIP_r*.json artifacts (and the gates' --json-out reports when

@@ -182,6 +182,25 @@ def build_problem(with_spread: bool = False, with_ipa: bool = False):
     return encode_problem(snapshot, default_pod(pod), SchedulerProfile())
 
 
+# Warmup/steady boundary snapshot (child process only): each scenario calls
+# _mark_steady() after its LAST warmup pass; the child main() splits the
+# backend-compile counters around the mark and fails the scenario when any
+# compile lands after it (the measured region must not trace).
+_PHASE_MARK: dict = {}
+
+
+def _mark_steady() -> None:
+    """Snapshot the backend-compile counters at the warmup/steady boundary.
+    Multi-phase scenarios mark after every warmup — last mark wins, so the
+    invariant enforced is "no compiles after the final warmup"."""
+    from cluster_capacity_tpu import obs
+    from cluster_capacity_tpu.utils.metrics import default_registry
+    _PHASE_MARK["recompiles"] = int(
+        default_registry.counter_total(obs.names.RECOMPILES))
+    _PHASE_MARK["compile_s"] = float(
+        default_registry.counter_total(obs.names.COMPILE_SECONDS))
+
+
 def bench_fast_path():
     from cluster_capacity_tpu.engine.fast_path import solve_auto
 
@@ -189,6 +208,7 @@ def bench_fast_path():
     t0 = time.perf_counter()
     solve_auto(pb)                       # warmup: compile + first execute
     warmup = time.perf_counter() - t0
+    _mark_steady()
     # Steady state is ONE sub-second call on CPU, so a single sample rides
     # the scheduler's mood — that one-sample noise is the whole r05 "-13%"
     # (BASELINE.md round-5 findings).  Best-of-N reps tracks the code, not
@@ -220,6 +240,7 @@ def bench_scan(platform: str, with_spread: bool = False,
     t0 = time.perf_counter()
     sim.solve(pb, max_limit=budget)
     warmup = time.perf_counter() - t0
+    _mark_steady()
     chunks_before = fused.STATS["chunks"]
     t0 = time.perf_counter()
     res = sim.solve(pb, max_limit=budget)
@@ -266,6 +287,7 @@ def bench_sweep(platform: str):
     t0 = time.perf_counter()
     sweep(snapshot, templates, max_limit=limit)
     warmup = time.perf_counter() - t0
+    _mark_steady()
     bchunks_before = fused.STATS.get("batched_chunks", 0)
     t0 = time.perf_counter()
     results = sweep(snapshot, templates, max_limit=limit)
@@ -388,6 +410,7 @@ def bench_c5(platform: str):
     t0 = time.perf_counter()
     sweep(snapshot, templates, max_limit=limit)       # warmup compile
     warmup = time.perf_counter() - t0
+    _mark_steady()
     t0 = time.perf_counter()
     results = sweep(snapshot, templates, max_limit=limit)
     dt = time.perf_counter() - t0
@@ -465,6 +488,7 @@ def _scenario_interleave():
     res = solve_interleaved_tensor(snapshot, templates, profile,
                                    max_total=budget)     # warmup compile
     warmup = time.perf_counter() - t0
+    _mark_steady()
     if res is None:
         # ineligible (e.g. device budget squeezed by env overrides): the
         # object path at this scale is minutes — report the miss instead
@@ -500,6 +524,7 @@ def _scenario_interleave():
                                             weight=3)]
     res_e = solve_interleaved_tensor(snapshot, templates, ext_profile,
                                      max_total=budget)    # warmup
+    _mark_steady()
     if res_e is not None:
         t0 = time.perf_counter()
         res_e = solve_interleaved_tensor(snapshot, templates, ext_profile,
@@ -569,11 +594,16 @@ def _scenario_resilience():
     analyze(snapshot, scenarios, probe, profile=profile, max_limit=limit,
             dedup=False)
     warmup = time.perf_counter() - t0
+    _mark_steady()
     t0 = time.perf_counter()
     report = analyze(snapshot, scenarios, probe, profile=profile,
                      max_limit=limit, dedup=False)
     dt = time.perf_counter() - t0
-    # the deduped sweep is the production default — time it too
+    # the deduped sweep is the production default — time it too; its
+    # collapsed geometry may compile separately, so it gets its own
+    # warmup + mark (last mark wins, see _mark_steady)
+    analyze(snapshot, scenarios, probe, profile=profile, max_limit=limit)
+    _mark_steady()
     t0 = time.perf_counter()
     deduped = analyze(snapshot, scenarios, probe, profile=profile,
                       max_limit=limit)
@@ -615,6 +645,7 @@ def _scenario_bounds():
     def _run(bounds):
         analyze(snapshot, scenarios, probe, profile=profile,      # warmup
                 max_limit=limit, dedup=False, bounds=bounds)
+        _mark_steady()
         t0 = time.perf_counter()
         rep = analyze(snapshot, scenarios, probe, profile=profile,
                       max_limit=limit, dedup=False, bounds=bounds)
@@ -707,10 +738,31 @@ def main() -> None:
         obs_profile.enable_memory_sampling()
         out = _SCENARIOS[scenario]()
         out["platform"] = _child_platform()
-        out["recompiles"] = int(
-            default_registry.counter_total(obs.names.RECOMPILES))
-        out["backend_compile_s"] = round(
-            default_registry.counter_total(obs.names.COMPILE_SECONDS), 3)
+        total_rc = int(default_registry.counter_total(obs.names.RECOMPILES))
+        total_cs = default_registry.counter_total(obs.names.COMPILE_SECONDS)
+        out["recompiles"] = total_rc
+        out["backend_compile_s"] = round(total_cs, 3)
+        # Warmup/steady compile split around the scenario's _mark_steady()
+        # snapshot.  A compile AFTER the mark means the measured region
+        # traced — the number is poisoned, so the scenario FAILS (exit 3)
+        # rather than shipping a quietly-compiling pps into the artifact.
+        # Scenarios that never mark (parity runs cold by design) opt out.
+        if _PHASE_MARK:
+            out["warmup_recompiles"] = _PHASE_MARK["recompiles"]
+            out["steady_recompiles"] = total_rc - _PHASE_MARK["recompiles"]
+            out["warmup_compile_s"] = round(_PHASE_MARK["compile_s"], 3)
+            out["steady_compile_s"] = round(
+                total_cs - _PHASE_MARK["compile_s"], 3)
+            if out["steady_recompiles"] and not os.environ.get(
+                    "BENCH_ALLOW_STEADY_RECOMPILES"):
+                sys.stderr.write(
+                    f"bench: scenario {scenario}: "
+                    f"{out['steady_recompiles']} backend compile(s) after "
+                    f"the steady mark ({out['steady_compile_s']}s) — the "
+                    f"measured region must not trace; fix the retrace or "
+                    f"set BENCH_ALLOW_STEADY_RECOMPILES=1\n")
+                print(json.dumps(out))
+                sys.exit(3)
         # Guarded-dispatch device attribution (obs/profile.py): lets the
         # trend check name the phase a regression lives in — compile vs
         # execute vs host — instead of just "pps fell".
@@ -814,7 +866,10 @@ def main() -> None:
         if not d:
             continue
         ph = {k: d[k] for k in ("warmup_s", "steady_s", "steady_reps_s",
-                                "recompiles", "backend_compile_s") if k in d}
+                                "recompiles", "backend_compile_s",
+                                "warmup_recompiles", "steady_recompiles",
+                                "warmup_compile_s", "steady_compile_s")
+              if k in d}
         if isinstance(d.get("device"), dict):
             ph["device"] = d["device"]
         if ph:

@@ -139,16 +139,23 @@ def _measure_entries(reps: int) -> Optional[Dict[str, Dict]]:
             return None
     from ..obs import profile as obs_profile
 
+    from ..obs import recompile as rc
+
     measured: Dict[str, Dict] = {}
     for spec in ir_entries.canonical_entries():
-        ir_entries._with_env(spec.env, spec.driver)  # warmup / compile
+        # the warmup call pays the entry's compile cost — tally it so the
+        # calibration artifact attributes compile seconds per entry (the
+        # same feed perfgate's PG005 compile budgets gate)
+        with rc.CompileTally() as tally:
+            ir_entries._with_env(spec.env, spec.driver)  # warmup / compile
         best = None
         for _ in range(max(1, reps)):
             t0 = time.perf_counter()
             ir_entries._with_env(spec.env, spec.driver)
             dt = time.perf_counter() - t0
             best = dt if best is None else min(best, dt)
-        entry: Dict = {"device_s": best, "rung": spec.rung}
+        entry: Dict = {"device_s": best, "rung": spec.rung,
+                       "compile_s": round(tally.seconds, 6)}
         peak = obs_profile.sample_watermark()
         if peak is not None:
             entry["mem_peak_bytes"] = peak

@@ -111,6 +111,12 @@ def calibrate(measured: Dict[str, Dict[str, Any]],
         }
         if mem_ratio is not None:
             entry["mem_ratio"] = mem_ratio
+        # warm-cache compile attribution (cli/profile.py tallies the warmup
+        # call): rides into calibration.json so compile creep is visible
+        # next to the efficiency it eventually erodes
+        comp = m.get("compile_s")
+        if isinstance(comp, (int, float)):
+            entry["compile_s"] = round(float(comp), 6)
         if note:
             entry["note"] = note
         entries[name] = entry

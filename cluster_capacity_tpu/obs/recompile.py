@@ -28,6 +28,33 @@ from . import spans as spans_mod
 _EVENT = "/jax/core/compile/backend_compile_duration"
 _lock = threading.Lock()
 _installed = False
+# live CompileTally sinks: jax.monitoring cannot deregister listeners, so
+# scoped measurement (perfgate's PG005 compile budgets, bench phase splits)
+# subscribes/unsubscribes HERE while the process-wide listener stays put
+_tallies: list = []
+
+
+class CompileTally:
+    """Scoped backend-compile tally: counts compiles and compile seconds
+    fired while the ``with`` block is open.  Installs the process-wide
+    listener on first use (one-shot, see module docstring) and registers
+    itself as a sink for its lifetime — the deregistration jax.monitoring
+    lacks lives in this list, not in jax."""
+
+    def __init__(self):
+        self.count = 0
+        self.seconds = 0.0
+
+    def __enter__(self) -> "CompileTally":
+        install_recompile_hook()
+        with _lock:
+            _tallies.append(self)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        with _lock:
+            if self in _tallies:
+                _tallies.remove(self)
 
 
 def install_recompile_hook(registry=None) -> bool:
@@ -46,6 +73,9 @@ def install_recompile_hook(registry=None) -> bool:
             return
         reg.inc(names.RECOMPILES)
         reg.inc(names.COMPILE_SECONDS, duration)
+        for tally in tuple(_tallies):
+            tally.count += 1
+            tally.seconds += duration
         sp = spans_mod.default_collector.active_sited()
         if sp is not None:
             sp.compile_s += duration

@@ -208,6 +208,12 @@ def solve_one_guarded(pb, max_limit: int = 0, *, deadline: float = 0.0,
             return _stamp(result, RUNG_FUSED, degraded)
 
         _record(fault, RUNG_FAST_PATH)
+        # the fused attempt may have died with device state mid-flight; the
+        # per-problem memos on pb (fast-path host state, device consts)
+        # were built under that backend, so drop them and let the lower
+        # rung rebuild from host inputs instead of replaying the blast
+        for memo in ("_fast_state_memo", "_device_consts_memo"):
+            pb.__dict__.pop(memo, None)
         result, fp_fault = _attempt(
             lambda: fast_path.solve_fast(pb, max_limit=max_limit,
                                          explain=explain),

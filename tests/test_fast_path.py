@@ -147,3 +147,53 @@ def test_fast_nonuniform_taint_on_statically_excluded_node_ok():
     pod = build_test_pod("p", 100, 64 * 1024 ** 2)
     fast = _compare(nodes, pod)
     assert all(fast.node_names[i] != "n3" for i in fast.placements)
+
+
+def test_fast_retrace_pin():
+    """solve_fast traces its device kernel EXACTLY once per static config:
+    explain on/off, bounds on/off (via solve_auto), and different
+    max_limit values must all replay the same cached trace — the r04→r06
+    throughput bleed was exactly this invariant eroding call by call."""
+    nodes = [build_test_node(f"n{i}", 2000, 4 * 1024 ** 3, 20)
+             for i in range(8)]
+    pod = build_test_pod("p", 100, 64 * 1024 ** 2)
+    snapshot = ClusterSnapshot.from_objects(nodes)
+    pb = enc.encode_problem(snapshot, default_pod(pod),
+                            SchedulerProfile.parity())
+    fast_path._fast_solve_device.cache_clear()
+    before = fast_path.trace_count()
+    expected = None
+    for explain in (False, True):
+        for limit in (0, 3, 17):
+            r = fast_path.solve_fast(pb, max_limit=limit, explain=explain)
+            assert r is not None
+            if limit == 3:
+                if expected is None:
+                    expected = r.placements
+                assert r.placements == expected      # kwargs never change it
+    for bounds in (False, True):
+        r = fast_path.solve_auto(pb, max_limit=3, bounds=bounds)
+        assert r.placements == expected
+    assert fast_path.trace_count() - before == 1
+
+
+def test_fast_retrace_pin_new_static_config_traces_again():
+    """The counter is per static config, not global: a different node
+    count (new static shape) costs one more trace, then replays too."""
+    profile = SchedulerProfile.parity()
+    nodes = [build_test_node(f"n{i}", 2000, 4 * 1024 ** 3, 20)
+             for i in range(8)]
+    pod = build_test_pod("p", 100, 64 * 1024 ** 2)
+    snapshot = ClusterSnapshot.from_objects(nodes)
+    pb = enc.encode_problem(snapshot, default_pod(pod), profile)
+    nodes2 = nodes + [build_test_node("n8", 2000, 4 * 1024 ** 3, 20)]
+    pb2 = enc.encode_problem(ClusterSnapshot.from_objects(nodes2),
+                             default_pod(pod), profile)
+    fast_path._fast_solve_device.cache_clear()
+    before = fast_path.trace_count()
+    assert fast_path.solve_fast(pb, max_limit=5) is not None
+    assert fast_path.solve_fast(pb2, max_limit=5) is not None
+    assert fast_path.trace_count() - before == 2
+    fast_path.solve_fast(pb, max_limit=9, explain=True)
+    fast_path.solve_fast(pb2, max_limit=9, explain=True)
+    assert fast_path.trace_count() - before == 2

@@ -401,6 +401,165 @@ def test_perfgate_bench_files_numeric_sort(tmp_path):
     assert names == ["BENCH_r02.json", "BENCH_r11.json", "BENCH_r100.json"]
 
 
+def test_perfgate_floor_guardrail_names_metric_and_delta():
+    """--update-pins must refuse to quietly lower a committed floor >10%
+    (the r05/r06 bleed rode exactly such re-pins); raising floors and new
+    metrics never refuse."""
+    prev = pg.make_pins(_bench(), "BENCH_r98.json")
+    lowered = pg.make_pins(
+        _bench(fast_path_placements_per_sec=40000.0,
+               resilience_scenarios_per_sec=12.5),    # new metric: fine
+        "BENCH_r99.json", prev=prev)
+    refusals = pg.floor_guardrail(lowered, prev)
+    assert len(refusals) == 1
+    assert "fast_path_placements_per_sec" in refusals[0]
+    assert "50000.00 -> 40000.00" in refusals[0]
+    assert "-20.0%" in refusals[0]
+    # within the guard band (or improving): no refusal
+    ok = pg.make_pins(_bench(fast_path_placements_per_sec=46000.0,
+                             value=2000.0), "BENCH_r99.json", prev=prev)
+    assert pg.floor_guardrail(ok, prev) == []
+    # no committed pins yet: nothing to guard
+    assert pg.floor_guardrail(lowered, None) == []
+
+
+def test_perfgate_update_pins_guardrail_cli(tmp_path, capsys):
+    """The CLI refuses to save a guard-tripping re-pin without
+    --allow-lower, and saves it with the flag."""
+    pins_path = str(tmp_path / "pins.json")
+    pg.save_pins(pg.make_pins(_bench(), "BENCH_r98.json"), pins_path)
+    slow = str(tmp_path / "BENCH_r99.json")
+    with open(slow, "w") as f:
+        json.dump(_bench(fast_path_placements_per_sec=40000.0), f)
+    rc = perfgate_main([slow, "--pins", pins_path, "--update-pins"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "refusing to lower" in out
+    assert "fast_path_placements_per_sec" in out and "--allow-lower" in out
+    assert pg.load_pins(pins_path)["platforms"]["cpu"]["metrics"][
+        "fast_path_placements_per_sec"] == 50000.0    # unchanged on refusal
+    rc = perfgate_main([slow, "--pins", pins_path, "--update-pins",
+                        "--allow-lower"])
+    assert rc == 0
+    assert pg.load_pins(pins_path)["platforms"]["cpu"]["metrics"][
+        "fast_path_placements_per_sec"] == 40000.0
+
+
+def test_perfgate_steady_recompiles_fail_pg005():
+    """A bench scenario reporting backend compiles after its steady mark is
+    a PG005 finding even with every throughput floor green."""
+    pins = pg.make_pins(_bench(), "BENCH_r98.json")
+    dirty = _bench()
+    dirty["phases"]["fast"].update(
+        {"warmup_recompiles": 3, "steady_recompiles": 2,
+         "warmup_compile_s": 0.9, "steady_compile_s": 0.31})
+    findings, skip = pg.compare(dirty, pins)
+    assert skip is None
+    assert [(f.metric, f.rule) for f in findings] == [
+        ("phases.fast", "PG005")]
+    assert "2 backend compile(s)" in findings[0].message
+    assert "0.31" in findings[0].message
+    # an explicit zero (the healthy split) stays clean
+    clean = _bench()
+    clean["phases"]["fast"]["steady_recompiles"] = 0
+    findings, _ = pg.compare(clean, pins)
+    assert findings == []
+
+
+def test_perfgate_compile_budget_pins_and_findings():
+    """compile_findings: over-budget is PG005 naming the entry and the
+    delta; unpinned entries are PG001; stale budgets are PG003; the noise
+    band (pct + absolute slack) absorbs small wall jitter; re-pins carry
+    budgets through like efficiency floors."""
+    measured = {"fast_path/n8b3": {"compile_s": 0.2, "compiles": 1,
+                                   "wall_s": 0.3}}
+    pins = pg.make_pins(_bench(), "BENCH_r98.json",
+                        compile_budgets={"fast_path/n8b3": 0.2})
+    assert pins["compile_tolerance_pct"] == pg.DEFAULT_COMPILE_TOLERANCE_PCT
+    assert pins["compile_min_delta_s"] == pg.DEFAULT_COMPILE_MIN_DELTA_S
+    assert pg.compile_findings(measured, pins, "cpu") == []
+    # inside the band: budget*1.5 + 0.5s
+    ok = {"fast_path/n8b3": {"compile_s": 0.75, "compiles": 2,
+                             "wall_s": 0.9}}
+    assert pg.compile_findings(ok, pins, "cpu") == []
+    over = {"fast_path/n8b3": {"compile_s": 1.1, "compiles": 9,
+                               "wall_s": 1.3}}
+    findings = pg.compile_findings(over, pins, "cpu")
+    assert [(f.metric, f.rule) for f in findings] == [
+        ("compile.fast_path/n8b3", "PG005")]
+    assert "0.200s pinned -> 1.100s measured" in findings[0].message
+    assert "+0.900s" in findings[0].message
+    # unpinned entry → PG001; budget with no entry → PG003
+    findings = pg.compile_findings(
+        {"scan/n8": {"compile_s": 0.1, "compiles": 1, "wall_s": 0.2}},
+        pins, "cpu")
+    assert sorted((f.metric, f.rule) for f in findings) == [
+        ("compile.fast_path/n8b3", "PG003"), ("compile.scan/n8", "PG001")]
+    # other platform has no slot → no findings (like compare's skip)
+    assert pg.compile_findings(over, pins, "tpu") == []
+    # budgets carry through a re-pin that doesn't remeasure
+    repin = pg.make_pins(_bench(), "BENCH_r99.json", prev=pins)
+    assert repin["platforms"]["cpu"]["compile_budgets"] == {
+        "fast_path/n8b3": 0.2}
+
+
+def test_compile_tally_scoped_measurement():
+    """CompileTally counts only the backend compiles fired inside its
+    scope, stacking with the process-wide counters."""
+    import jax
+    import jax.numpy as jnp
+
+    from cluster_capacity_tpu.obs import recompile as rc
+
+    with rc.CompileTally() as outside:
+        pass
+    with rc.CompileTally() as tally:
+        f = jax.jit(lambda x: x * 3 + 2)
+        f(jnp.ones((4, 7))).block_until_ready()
+    assert tally.count >= 1
+    assert tally.seconds > 0.0
+    assert outside.count == 0 and outside.seconds == 0.0
+    assert rc._tallies == []            # scope exits deregister
+
+
+@pytest.mark.slow
+def test_compilegate_fails_on_seeded_trace_bloat(monkeypatch):
+    """Seeded compile-time regression: inflate the least_allocated score
+    graph (the strategy the fast_path ladder entry uses) and the measured
+    cold-cache compile seconds for that entry must blow past a budget
+    pinned at the healthy cost, with PG005 naming the entry and the
+    delta.  Each injected copy perturbs its input (CSE would otherwise
+    fold identical subgraphs and hide the bloat)."""
+    from cluster_capacity_tpu.ops import node_resources_fit as nrf
+    from tools.perfgate import compilebudget
+
+    healthy = compilebudget.measure(only=("fast_path/n8b3",))
+    entry = healthy["fast_path/n8b3"]
+    assert entry["compiles"] >= 1
+
+    orig = nrf.least_allocated_score
+
+    def bloated(alloc, *a, **kw):
+        total = orig(alloc, *a, **kw)
+        for i in range(1, 500):
+            total = total + orig(alloc * (1.0 + i * 1e-9), *a, **kw) * 0.0
+        return total
+
+    monkeypatch.setattr(nrf, "least_allocated_score", bloated)
+    regressed = compilebudget.measure(only=("fast_path/n8b3",))
+    pins = pg.make_pins(_bench(), "BENCH_r98.json",
+                        compile_budgets={
+                            "fast_path/n8b3": entry["compile_s"]})
+    findings = pg.compile_findings(regressed, pins, "cpu")
+    assert [(f.metric, f.rule) for f in findings] == [
+        ("compile.fast_path/n8b3", "PG005")]
+    assert "compile budget exceeded" in findings[0].message
+    got = regressed["fast_path/n8b3"]["compile_s"]
+    assert f"{got:.3f}s measured" in findings[0].message
+    # and the healthy measurement itself stays inside its own band
+    assert pg.compile_findings(healthy, pins, "cpu") == []
+
+
 # --- CLI surfaces ------------------------------------------------------------
 
 def test_resilience_cli_dumps_metrics_and_trace(tmp_path):

@@ -12,8 +12,19 @@ Flags:
 
   --pins PATH      compare against an alternate pins file
   --update-pins    rewrite the pins file from this artifact's metrics
-                   (hand-curated efficiency_floors carry through untouched)
+                   (hand-curated efficiency_floors carry through untouched);
+                   refuses to lower an existing throughput floor by more
+                   than 10% unless --allow-lower
+  --allow-lower    override the --update-pins lowering guardrail after
+                   reviewing the named deltas
   --tolerance PCT  tolerance band written by --update-pins (default 10)
+  --compile-budget run the cold-cache compile-seconds measurement
+                   (tools/perfgate/compilebudget.py) over the canonical
+                   irgate ladder: gates PG005 against the pinned
+                   compile_budgets, or writes fresh budgets under
+                   --update-pins
+  --entry SUBSTR   with --compile-budget: only ladder entries whose name
+                   contains SUBSTR (repeatable)
   --calibration F  a `hypercc profile` calibration.json: kernel-efficiency
                    ratios checked against the pins' efficiency_floors —
                    PG004 findings are informational and never flip the
@@ -40,8 +51,18 @@ def main(argv=None) -> int:
                          "committed BENCH_r*.json)")
     ap.add_argument("--pins", metavar="PATH", default=gate.DEFAULT_PINS)
     ap.add_argument("--update-pins", action="store_true")
+    ap.add_argument("--allow-lower", action="store_true",
+                    help="let --update-pins lower existing floors past "
+                         "the guardrail")
     ap.add_argument("--tolerance", type=float,
                     default=gate.DEFAULT_TOLERANCE_PCT, metavar="PCT")
+    ap.add_argument("--compile-budget", action="store_true",
+                    help="measure cold-cache compile seconds per canonical "
+                         "ladder entry (PG005)")
+    ap.add_argument("--entry", action="append", default=[],
+                    metavar="SUBSTR",
+                    help="with --compile-budget: filter ladder entries by "
+                         "name substring (repeatable)")
     ap.add_argument("--calibration", metavar="FILE", default="",
                     help="hypercc profile calibration.json for the "
                          "informational PG004 efficiency check")
@@ -74,20 +95,51 @@ def main(argv=None) -> int:
             bench = gate.merge_rates(bench, mdoc)
             bench_label += f" + {os.path.basename(mc_files[-1])}"
 
+    measured_compile = None
+    if args.compile_budget:
+        from . import compilebudget
+        measured_compile = compilebudget.measure(only=args.entry or None)
+        for name in sorted(measured_compile):
+            e = measured_compile[name]
+            print(f"perfgate: compile {name}: {e['compile_s']}s over "
+                  f"{e['compiles']} backend compile(s) "
+                  f"(wall {e['wall_s']}s)")
+
     if args.update_pins:
+        budgets = None
+        if measured_compile is not None:
+            budgets = {k: v["compile_s"]
+                       for k, v in measured_compile.items()}
+        prev = gate.load_pins(args.pins)
         doc = gate.make_pins(bench, bench_label,
                              tolerance_pct=args.tolerance,
-                             prev=gate.load_pins(args.pins))
+                             prev=prev, compile_budgets=budgets)
+        refusals = gate.floor_guardrail(doc, prev)
+        if refusals and not args.allow_lower:
+            for line in refusals:
+                print(f"perfgate: refusing to lower {line}")
+            print(f"perfgate: --update-pins refused — {len(refusals)} "
+                  f"floor(s) would drop more than "
+                  f"{gate.FLOOR_LOWER_GUARD_PCT:g}%; if the slowdown is "
+                  f"real and reviewed, re-run with --allow-lower")
+            return 1
         platform = bench.get("platform", "unknown")
-        n = len(doc["platforms"][platform]["metrics"])
+        slot = doc["platforms"][platform]
+        n = len(slot["metrics"])
         gate.save_pins(doc, args.pins)
-        print(f"perfgate: pinned {n} metric floor(s) for platform "
-              f"'{platform}' from {bench_label} to "
-              f"{os.path.relpath(args.pins, gate.ROOT)}")
+        msg = (f"perfgate: pinned {n} metric floor(s)"
+               + (f" and {len(slot.get('compile_budgets') or {})} compile "
+                  f"budget(s)" if budgets is not None else "")
+               + f" for platform '{platform}' from {bench_label} to "
+                 f"{os.path.relpath(args.pins, gate.ROOT)}")
+        print(msg)
         return 0
 
     pins = gate.load_pins(args.pins)
     findings, skip = gate.compare(bench, pins)
+    if measured_compile is not None:
+        findings.extend(gate.compile_findings(
+            measured_compile, pins, bench.get("platform", "unknown")))
     info = []
     if args.calibration:
         with open(args.calibration, "r", encoding="utf-8") as fh:
@@ -104,6 +156,8 @@ def main(argv=None) -> int:
         "informational": [{"metric": f.metric, "rule": f.rule,
                            "message": f.message} for f in info],
     }
+    if measured_compile is not None:
+        doc["compile"] = measured_compile
     if args.json_out:
         with open(args.json_out, "w", encoding="utf-8") as fh:
             json.dump(doc, fh, indent=2)

@@ -1,0 +1,56 @@
+"""Compile-seconds measurement per canonical ladder entry (PG005 feed).
+
+FLOPs creep is already gated statically (tools/irgate budgets) and
+steady-state throughput dynamically (perfgate PG002 floors) — but trace +
+backend-compile cost was a side effect nobody owned, and it is exactly how
+the fast path bled 24% across r04→r06 while every gate stayed green.  This
+module makes compile time a budgeted resource: it re-runs the SAME canonical
+entry drivers irgate lowers (tools/irgate/entries.py), from a cold compile
+cache, and tallies the backend-compile seconds each entry pays via the
+jax.monitoring listener (obs/recompile.py CompileTally).
+
+Cold-start discipline: before each entry, ``jax.clear_caches()`` drops jit's
+executable caches and ``capture._clear_package_factory_caches()`` empties
+every lru_cached kernel factory in the package (sim._chunk_runner,
+fast_path._fast_solve_device, ...), so the measurement is the full
+trace+compile cost a fresh process would pay — not whatever the previous
+entry left warm.  Budgets are wall-noise-tolerant by construction: the gate
+compares against ``budget * (1 + compile_tolerance_pct/100) +
+compile_min_delta_s`` (gate.compile_findings), so only genuine trace bloat
+— more/larger HLO, not scheduler jitter — trips PG005.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Iterable, Optional
+
+
+def measure(only: Optional[Iterable[str]] = None) -> Dict[str, dict]:
+    """{entry_name: {"compile_s", "compiles", "wall_s"}} for the canonical
+    ladder (or the entries whose names contain a substring in ``only``).
+    Each entry runs from a cold compile cache; compile_s is the sum of
+    backend-compile durations its driver fired."""
+    import jax
+
+    from cluster_capacity_tpu.obs import recompile as rc
+    from tools.irgate import capture as cap
+    from tools.irgate import entries as entries_mod
+
+    filters = tuple(only) if only else ()
+    out: Dict[str, dict] = {}
+    for spec in entries_mod.canonical_entries():
+        if filters and not any(f in spec.name for f in filters):
+            continue
+        jax.clear_caches()
+        cap._clear_package_factory_caches()
+        with rc.CompileTally() as tally:
+            t0 = time.perf_counter()
+            entries_mod._with_env(spec.env, spec.driver)
+            wall = time.perf_counter() - t0
+        out[spec.name] = {
+            "compile_s": round(tally.seconds, 3),
+            "compiles": int(tally.count),
+            "wall_s": round(wall, 3),
+        }
+    return out

@@ -19,6 +19,14 @@ Rules:
          measured on whatever host ran them, so a hard floor would gate
          the weather — the finding names the entry and ratio, the exit
          code ignores it
+  PG005  compile-time creep: a canonical ladder entry's cold-cache
+         backend-compile seconds (tools/perfgate/compilebudget.py) exceed
+         its pinned ``compile_budgets`` entry beyond the
+         ``compile_tolerance_pct`` band plus the ``compile_min_delta_s``
+         absolute slack; ALSO raised from the bench artifact when any
+         scenario reports ``steady_recompiles`` > 0 — compile work leaking
+         past warmup into the measured region is a compile-budget
+         violation even before it moves a throughput floor
 
 Pins are platform-keyed: ``pins.json`` holds a ``platforms`` map with one
 slot per platform (cpu, tpu, ...), each carrying its own source, metric
@@ -48,6 +56,16 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 ROOT = os.path.dirname(os.path.dirname(_HERE))
 DEFAULT_PINS = os.path.join(_HERE, "pins.json")
 DEFAULT_TOLERANCE_PCT = 10.0
+# Compile budgets (PG005) tolerate far more relative noise than throughput
+# floors: a cold backend compile is a fraction of a second of single-core
+# work whose wall time rides the host scheduler, so the band is wide AND
+# backed by an absolute slack — only genuine trace bloat clears both.
+DEFAULT_COMPILE_TOLERANCE_PCT = 50.0
+DEFAULT_COMPILE_MIN_DELTA_S = 0.5
+# --update-pins guardrail: refuse to silently re-pin a throughput floor
+# more than this far below its committed value (the r05/r06 bleed rode
+# exactly such re-pins); --allow-lower overrides after review.
+FLOOR_LOWER_GUARD_PCT = 10.0
 
 _HEADER = (
     "Bench throughput floors pinned by tools/perfgate (PR 6).  Regenerate "
@@ -198,9 +216,14 @@ def load_pins(path: str = DEFAULT_PINS) -> Optional[Dict[str, Any]]:
 
 def make_pins(bench: Dict[str, Any], source: str,
               tolerance_pct: float = DEFAULT_TOLERANCE_PCT,
-              prev: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+              prev: Optional[Dict[str, Any]] = None,
+              compile_budgets: Optional[Dict[str, float]] = None
+              ) -> Dict[str, Any]:
     """Pin this bench's metrics into its platform's slot; every other
-    platform slot in ``prev`` carries through untouched."""
+    platform slot in ``prev`` carries through untouched.  ``compile_budgets``
+    (entry name -> cold-cache compile seconds, from compilebudget.measure)
+    writes the platform's PG005 budgets; when omitted, previously pinned
+    budgets carry through like the efficiency floors."""
     prev = _normalize_pins(prev)
     platform = bench.get("platform", "unknown")
     platforms: Dict[str, Any] = {}
@@ -213,12 +236,55 @@ def make_pins(bench: Dict[str, Any], source: str,
     prev_slot = platforms.get(platform) or {}
     if isinstance(prev_slot.get("efficiency_floors"), dict):
         slot["efficiency_floors"] = dict(prev_slot["efficiency_floors"])
+    if compile_budgets:
+        slot["compile_budgets"] = {
+            k: float(v) for k, v in sorted(compile_budgets.items())}
+    elif isinstance(prev_slot.get("compile_budgets"), dict):
+        slot["compile_budgets"] = dict(prev_slot["compile_budgets"])
     platforms[platform] = slot
-    return {
+    doc = {
         "_comment": _HEADER,
         "tolerance_pct": float(tolerance_pct),
         "platforms": platforms,
     }
+    if prev:
+        # the PG005 noise band is part of the reviewed contract, like
+        # tolerance_pct — carry any hand-tuned values through a re-pin
+        for key in ("compile_tolerance_pct", "compile_min_delta_s"):
+            if isinstance(prev.get(key), (int, float)):
+                doc[key] = float(prev[key])
+    doc.setdefault("compile_tolerance_pct", DEFAULT_COMPILE_TOLERANCE_PCT)
+    doc.setdefault("compile_min_delta_s", DEFAULT_COMPILE_MIN_DELTA_S)
+    return doc
+
+
+def floor_guardrail(new_doc: Dict[str, Any],
+                    prev: Optional[Dict[str, Any]],
+                    threshold_pct: float = FLOOR_LOWER_GUARD_PCT
+                    ) -> List[str]:
+    """--update-pins guardrail: refusals for every throughput floor the new
+    pins document would lower by more than ``threshold_pct`` vs the
+    committed ``prev``.  Each refusal names the metric and the delta; an
+    empty list means the re-pin is safe to save.  Raising floors, new
+    metrics, and platforms absent from ``prev`` never refuse."""
+    prev = _normalize_pins(prev)
+    if not prev:
+        return []
+    out: List[str] = []
+    for platform, slot in sorted((new_doc.get("platforms") or {}).items()):
+        old_metrics = ((prev.get("platforms") or {}).get(platform)
+                       or {}).get("metrics") or {}
+        for name, value in sorted((slot.get("metrics") or {}).items()):
+            old = old_metrics.get(name)
+            if not isinstance(old, (int, float)) or old <= 0 \
+                    or not isinstance(value, (int, float)):
+                continue
+            if value < old * (1.0 - threshold_pct / 100.0):
+                out.append(
+                    f"{name}: floor {old:.2f} -> {value:.2f} "
+                    f"({(value / old - 1.0) * 100.0:+.1f}%, guard "
+                    f"-{threshold_pct:g}%)")
+    return out
 
 
 def save_pins(doc: Dict[str, Any], path: str = DEFAULT_PINS) -> None:
@@ -275,6 +341,24 @@ def compare(bench: Dict[str, Any], pins: Optional[Dict[str, Any]]
                 "pinned metric missing from the bench artifact — stale pin "
                 "or a scenario stopped producing its key; run "
                 "--update-pins if the removal was deliberate"))
+    # steady-state recompiles are a compile-budget violation regardless of
+    # whether the throughput floor moved: compile work leaking past the
+    # warmup mark poisons every steady rep behind it
+    phases = bench.get("phases") or {}
+    for scen in sorted(phases) if isinstance(phases, dict) else []:
+        ph = phases.get(scen)
+        if not isinstance(ph, dict):
+            continue
+        steady = ph.get("steady_recompiles")
+        if isinstance(steady, (int, float)) and steady > 0:
+            extra = ph.get("steady_compile_s")
+            note = (f" ({extra}s backend compile in the steady region)"
+                    if isinstance(extra, (int, float)) else "")
+            findings.append(PerfFinding(
+                f"phases.{scen}", "PG005",
+                f"{int(steady)} backend compile(s) after the scenario's "
+                f"steady mark{note} — the measured region must not trace; "
+                f"fix the retrace or widen the warmup"))
     return (findings, None)
 
 
@@ -307,4 +391,57 @@ def efficiency_findings(calibration: Optional[Dict[str, Any]],
                 f"kernel efficiency {eff:.3f} below informational floor "
                 f"{floor:g} (calibration: obs/costmodel.py via "
                 f"`hypercc profile`; does not fail the gate)"))
+    return out
+
+
+def compile_findings(measured: Dict[str, Dict[str, Any]],
+                     pins: Optional[Dict[str, Any]],
+                     platform: str) -> List[PerfFinding]:
+    """PG005 vs the pinned per-entry compile budgets.  ``measured`` is
+    compilebudget.measure()'s output (entry -> {"compile_s", "compiles",
+    "wall_s"}).  An entry over ``budget * (1 + compile_tolerance_pct/100) +
+    compile_min_delta_s`` is a failure; a measured entry with no budget is
+    PG001 (pin it); a budgeted entry that no longer runs is PG003 (stale
+    pin).  No pinned slot for the platform -> no findings (like compare's
+    platform skip)."""
+    pins = _normalize_pins(pins)
+    if pins is None:
+        return []
+    slot = (pins.get("platforms") or {}).get(platform)
+    if slot is None:
+        return []
+    budgets: Dict[str, Any] = slot.get("compile_budgets") or {}
+    tol = float(pins.get("compile_tolerance_pct",
+                         DEFAULT_COMPILE_TOLERANCE_PCT))
+    slack = float(pins.get("compile_min_delta_s",
+                           DEFAULT_COMPILE_MIN_DELTA_S))
+    out: List[PerfFinding] = []
+    for name in sorted(measured):
+        entry = measured[name]
+        got = float(entry.get("compile_s", 0.0))
+        budget = budgets.get(name)
+        if not isinstance(budget, (int, float)):
+            out.append(PerfFinding(
+                f"compile.{name}", "PG001",
+                f"ladder entry has no committed compile budget (measured "
+                f"{got:.3f}s over {entry.get('compiles', '?')} compiles) — "
+                f"run --update-pins --compile-budget and review the pin"))
+            continue
+        limit = budget * (1.0 + tol / 100.0) + slack
+        if got > limit:
+            out.append(PerfFinding(
+                f"compile.{name}", "PG005",
+                f"compile budget exceeded: {budget:.3f}s pinned -> "
+                f"{got:.3f}s measured (+{got - budget:.3f}s, limit "
+                f"{limit:.3f}s = budget +{tol:g}% +{slack:g}s; "
+                f"{entry.get('compiles', '?')} backend compiles) — the "
+                f"entry's trace got bigger; fix the bloat or re-pin with "
+                f"--update-pins --compile-budget after review"))
+    for name in sorted(budgets):
+        if name not in measured:
+            out.append(PerfFinding(
+                f"compile.{name}", "PG003",
+                "pinned compile budget has no matching ladder entry — "
+                "stale pin; run --update-pins --compile-budget if the "
+                "entry's removal was deliberate"))
     return out

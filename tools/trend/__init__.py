@@ -94,6 +94,16 @@ def collect(root: str = ROOT) -> dict:
                 put(k, rnd, v)
         if isinstance(parsed.get("phases"), dict):
             phases[rnd] = parsed["phases"]
+            # compile seconds trend as first-class rows (from r07 on the
+            # artifacts split them around the steady mark): creep shows up
+            # in the cross-round table even while every pps floor holds
+            for scen, ph in parsed["phases"].items():
+                if not isinstance(ph, dict):
+                    continue
+                put(f"{scen}_compile_s", rnd, ph.get("backend_compile_s"))
+                if "steady_compile_s" in ph:
+                    put(f"{scen}_steady_compile_s", rnd,
+                        ph.get("steady_compile_s"))
 
     for rnd, path in _artifact_files(root, "MULTICHIP_r*.json"):
         doc = _load(path)
