@@ -73,10 +73,15 @@ bench:
 # resilience sweep over a synthetic 2k-node fleet on a virtual 8-device
 # CPU mesh; proves sharded == unsharded bit-identity twice (bounds-pruned
 # pass + forced-solve pass) and records placements/s (total and per
-# device) into MULTICHIP_r06.json for tools/perfgate and tools/trend.
+# device) into MULTICHIP_r07.json for tools/perfgate and tools/trend.
+# The interleaved multi-template rung runs at 2k (pinned) and 16k nodes
+# by default; pass INTERLEAVE_SCALES=2000,16000,64000 for the slow 64k
+# rung.
+INTERLEAVE_SCALES ?= 2000,16000
 multichip:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORM_NAME=cpu \
-		$(PY) -m tools.multichip_bench --out MULTICHIP_r06.json
+		$(PY) -m tools.multichip_bench --out MULTICHIP_r07.json \
+		--interleave-scales $(INTERLEAVE_SCALES)
 
 # Throughput regression gate: latest committed BENCH_r*.json vs the pinned
 # floors in tools/perfgate/pins.json (the perf counterpart of irgate's

@@ -77,9 +77,10 @@ def build_parser(prog: str = "cluster-capacity") -> argparse.ArgumentParser:
                         "(batch x node shards, e.g. 2x4), 'auto' (best mesh "
                         "over every visible device; single-device hosts "
                         "stay unsharded), or 'none' (default — unsharded). "
-                        "Applies to multi-podspec sweeps and batchable "
-                        "single-pod runs; --explain and --interleave stay "
-                        "on the per-template path.")
+                        "Applies to multi-podspec sweeps, batchable "
+                        "single-pod runs, and --interleave (the "
+                        "stacked-template race shards over the same mesh); "
+                        "--explain stays on the per-template path.")
     p.add_argument("--no-bounds", dest="no_bounds", action="store_true",
                    help="Disable bound-guided scan-budget right-sizing "
                         "(bounds/bracket.py): solves keep the full step "
@@ -347,9 +348,10 @@ def run(argv: Optional[List[str]] = None, prog: str = "cluster-capacity") -> int
                 # the race through one mutable cluster state has no
                 # per-template elimination story to attribute
                 from ..parallel.interleave import sweep_interleaved_auto
-                results = sweep_interleaved_auto(snapshot, pods,
-                                                 profile=profile,
-                                                 max_total=args.max_limit)
+                results = sweep_interleaved_auto(
+                    snapshot, pods, profile=profile,
+                    max_total=args.max_limit, mesh=mesh,
+                    bounds=False if args.no_bounds else None)
             else:
                 results = sweep(snapshot, pods, profile=profile,
                                 max_limit=args.max_limit, mesh=mesh,

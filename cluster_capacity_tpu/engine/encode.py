@@ -445,3 +445,30 @@ def encode_problem(snapshot: ClusterSnapshot, pod: dict,
         num_alive=int(alive.sum()) if alive is not None else n,
         max_steps_hint=hint,
     )
+
+
+def encode_problems_shared(snapshot: ClusterSnapshot,
+                           templates, profile: SchedulerProfile,
+                           ipa_extra_keys=()):
+    """Group-encode ``templates`` against one snapshot, memoised on it.
+
+    The interleaved race re-derives the SAME template list from the same
+    snapshot on every dispatch (auto sweep retries, ladder fallbacks from
+    the sharded rung to the unsharded tensor path), and encode_problem is
+    the dominant host cost at fleet node counts.  Identity comparison —
+    not equality — keys the memo: template dicts are mutable, and the
+    callers that rebuild snapshots after eviction pass brand-new snapshot
+    objects whose memo store starts empty, so staleness cannot leak
+    across rebuilds.
+    """
+    store = snapshot.memo(("encode_problems_shared",), list)
+    keys = tuple(ipa_extra_keys)
+    for tpls, prof, ks, pbs in store:
+        if (prof is profile and ks == keys
+                and len(tpls) == len(templates)
+                and all(a is b for a, b in zip(tpls, templates))):
+            return pbs
+    pbs = [encode_problem(snapshot, t, profile, ipa_extra_keys=keys)
+           for t in templates]
+    store.append((list(templates), profile, keys, pbs))
+    return pbs

@@ -149,3 +149,36 @@ def solve_on_mesh(pb, mesh, max_limit: int = 0, chunk_size: int = 1024):
 
     return sim.solve(pb, max_limit=max_limit, chunk_size=chunk_size,
                      mesh=mesh)
+
+
+def local_mesh(n_batch_shards: int = 1):
+    """A (batch, nodes) mesh over THIS process's devices only."""
+    import jax
+
+    devs = jax.local_devices()
+    return mesh_lib.make_mesh(
+        n_node_shards=max(1, len(devs) // n_batch_shards),
+        n_batch_shards=n_batch_shards, devices=devs)
+
+
+def interleave_on_mesh(snapshot, templates, profile=None, max_total: int = 0,
+                       mesh=None):
+    """Multi-template interleaved race on a mesh, multi-process safe.
+
+    The race's host control loop reads small device scalars back after
+    every chunk; on a multi-process runtime a readback requires the array
+    to be process-addressable, so each process runs the race on its OWN
+    local-device mesh (replicated host control — the standard pattern for
+    control-heavy loops over DCN; every host computes the identical result
+    because the race is deterministic) while jax.distributed keeps the
+    hosts in one runtime for the surrounding sharded sweeps.
+    Single-process runtimes take the full mesh."""
+    import jax
+
+    from .interleave import sweep_interleaved_auto
+
+    if mesh is None:
+        mesh = (local_mesh() if jax.process_count() > 1
+                else mesh_lib.make_mesh())
+    return sweep_interleaved_auto(snapshot, templates, profile,
+                                  max_total=max_total, mesh=mesh)

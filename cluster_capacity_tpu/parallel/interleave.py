@@ -46,6 +46,22 @@ Queue semantics mirrored exactly (differentially tested):
   sweep_interleaved), implemented in-step so the requeue ordering matches
   the object path placement-for-placement.
 
+Fleet scale (mesh=...): the same race runs as ONE jitted scan whose stacked
+per-template state is sharded over the {batch, nodes} device mesh — the
+template axis rides the mesh's batch axis, every node table rides the node
+axis (parallel/mesh.py PartitionSpecs).  The node axis pads with inert rows
+(statically infeasible, domainless — mesh.pad_for_mesh semantics, including
+the sampling-rotation wrap argument) and the template axis quantizes to the
+next power of two, so a whole family of template mixes shares one cached
+runner per (mesh, static config) and the executable never recompiles across
+alive-mask or mix changes.  Bounds guidance (bounds=True) brackets the whole
+mix first (bounds/bracket.bracket_mix): the scan budget is right-sized to
+the group's joint upper bound and templates that are statically infeasible
+on every node skip straight to their (moment-independent) diagnosis instead
+of burning a pop + host halt.  Both are bit-identity preserving — the
+differential oracle chain is sharded → unsharded tensor → object loop
+(tests/test_interleave_sharded.py).
+
 Reference: the queue pop loop is the scheduler's core
 (vendor/.../backend/queue/scheduling_queue.go:94-134); one scheduling cycle
 per pop (schedule_one.go:66-150).
@@ -65,6 +81,7 @@ from ..models import podspec as ps
 from ..models.snapshot import ClusterSnapshot
 from ..ops import inter_pod_affinity as ipa_ops
 from ..utils.config import SchedulerProfile
+from . import mesh as mesh_lib
 
 # total per-template-tensor elements (T*C*N summed over the ~7 stacked count
 # tensors) the engine will put on device before falling back
@@ -582,6 +599,96 @@ def _xchunk_runner():
     return run
 
 
+# Cross-template consts that carry a trailing node axis ([T, N]) — these
+# shard over the node axis; the [T]/[T, T]/[T, T, G] matrices are tiny and
+# replicate (the popped template's row is read with a traced index every
+# step, so replication keeps that read collective-free).
+_XCONSTS_NODE = frozenset({"ext_mask", "ext_bonus", "static_ports_fail"})
+
+
+def _xconsts_shardings(mesh, xconsts):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    rep = NamedSharding(mesh, P())
+    node = NamedSharding(mesh, P(None, mesh_lib.NODE_AXIS))
+    return {k: (node if k in _XCONSTS_NODE else rep) for k in xconsts}
+
+
+def _xcarry_shardings(mesh, track_tpl: bool):
+    """NamedSharding pytree for XCarry: the template axis rides the mesh's
+    batch axis, node tables ride the node axis, the shared queue scalars
+    replicate.  The [1, 1] tpl_placed dummy replicates (nothing to shard)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    B, N = mesh_lib.BATCH_AXIS, mesh_lib.NODE_AXIS
+
+    def sp(*parts):
+        return NamedSharding(mesh, P(*parts))
+
+    return XCarry(
+        requested=sp(N, None), nonzero=sp(N, None),
+        tpl_placed=sp(B, N) if track_tpl else sp(None, None),
+        sh_cnt=sp(B, None, N), ss_cnt=sp(B, None, N), ssh_cnt=sp(B, None, N),
+        aff_cnt=sp(B, None, N), anti_cnt=sp(B, None, N),
+        eanti_cnt=sp(B, None, N), pref_cnt=sp(B, None, N),
+        aff_total=sp(B), k=sp(B), active=sp(B), parked_curable=sp(B),
+        last_seq=sp(B), next_start=sp(B),
+        seq_next=sp(), quota=sp(), halt=sp(), halt_ti=sp())
+
+
+# Compiled sharded runners, keyed on (mesh, consts key-sets, tpl tracking):
+# the in/out sharding pytrees depend only on which consts the group carries,
+# so a fixed mesh reuses one wrapper — and, with the template axis quantized
+# to a power of two and the node axis padded to the shard multiple, one
+# EXECUTABLE across alive-mask and template-mix changes (shapes, specs and
+# StaticConfig all match; tests/test_interleave_sharded.py pins zero steady
+# recompiles).
+_XSHARDED_RUNNERS: Dict[tuple, object] = {}
+
+
+def _xchunk_runner_sharded(mesh, sconsts, xconsts, track_tpl: bool):
+    """Mesh-sharded interleave runner: the same _xstep scan, dispatched under
+    jax.jit with explicit in_shardings (stacked template consts batched over
+    the mesh exactly like sweep._batched_chunk_runner_sharded) and the carry
+    donated — the scan updates the per-template count planes in place across
+    chunks.  Cross-template reductions (tier-ranked argmin pop, global score
+    argmax) cross the sharded axes, so GSPMD lowers them to collectives
+    instead of gathering node tables to one device (irgate IC007)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    key = (mesh, tuple(sorted(sconsts)), tuple(sorted(xconsts)), track_tpl)
+    fn = _XSHARDED_RUNNERS.get(key)
+    if fn is not None:
+        return fn
+
+    rep = NamedSharding(mesh, P())
+    in_sh = (mesh_lib.consts_shardings(mesh, sconsts, batched=True),
+             _xconsts_shardings(mesh, xconsts),
+             _xcarry_shardings(mesh, track_tpl))
+    # emits stack to [length] scalars per step → replicated
+    out_sh = (in_sh[2], (rep, rep))
+
+    @functools.partial(jax.jit, static_argnames=("cfg", "length"),
+                       in_shardings=in_sh, out_shardings=out_sh,
+                       donate_argnames=("xc",))
+    def run(cfg, sconsts, xconsts, xc, length: int):
+        def body(c, _):
+            return _xstep(cfg, sconsts, xconsts, c)
+        return jax.lax.scan(body, xc, None, length=length)
+
+    _XSHARDED_RUNNERS[key] = run
+    return run
+
+
+def _quantize_templates(t_n: int, mesh) -> int:
+    """Template-axis pad target: next power of two (so nearby mix sizes
+    share an executable), then up to the mesh's batch-shard multiple."""
+    t_pad = 1 << max(0, t_n - 1).bit_length() if t_n > 1 else 1
+    if mesh is not None:
+        nb = int(mesh.shape[mesh_lib.BATCH_AXIS])
+        t_pad = -(-t_pad // nb) * nb
+    return t_pad
+
+
 # --------------------------------------------------------------------------
 # the host loop
 # --------------------------------------------------------------------------
@@ -589,10 +696,16 @@ def _xchunk_runner():
 def solve_interleaved_tensor(snapshot: ClusterSnapshot,
                              templates: Sequence[dict],
                              profile: Optional[SchedulerProfile] = None,
-                             max_total: int = 0
+                             max_total: int = 0, *,
+                             mesh=None, bounds: bool = False
                              ) -> Optional[List[sim.SolveResult]]:
     """Run the interleaved study on device; None when ineligible (callers
-    fall back to sweep.sweep_interleaved, the object-level parity path)."""
+    fall back to sweep.sweep_interleaved, the object-level parity path).
+
+    mesh: shard the stacked template race over a {batch, nodes} device mesh
+    (module docstring); bounds: bracket the mix first and right-size the
+    scan budget / skip statically-impossible templates.  Both preserve
+    bit-identity with the unsharded, unbounded run."""
     import jax
     import jax.numpy as jnp
 
@@ -608,9 +721,8 @@ def solve_interleaved_tensor(snapshot: ClusterSnapshot,
 
     sim._ensure_x64(profile)
     extra_keys = union_topology_keys(templates)
-    pbs_all = [enc.encode_problem(snapshot, t, profile,
-                                  ipa_extra_keys=extra_keys)
-               for t in templates]
+    pbs_all = enc.encode_problems_shared(snapshot, templates, profile,
+                                         ipa_extra_keys=extra_keys)
     reason = eligible(snapshot, templates, profile, pbs_all)
     if reason is not None:
         return None
@@ -671,13 +783,36 @@ def solve_interleaved_tensor(snapshot: ClusterSnapshot,
             ext_bonus_np[ti] = np.asarray(
                 [bonus.get(nm, 0.0) for nm in all_names])
 
+    # Pad targets: the node axis pads to the mesh's shard multiple with
+    # inert rows (statically infeasible, domainless — behaviorally identical
+    # to trailing infeasible nodes, including the sampling-rotation wrap);
+    # the template axis quantizes to a power of two (then the batch-shard
+    # multiple) with duplicate-last rows that start inactive and can never
+    # pop.  Unsharded runs keep the exact legacy shapes.
+    if mesh is not None:
+        nn = int(mesh.shape[mesh_lib.NODE_AXIS])
+        n_pad = -(-n // nn) * nn
+        t_pad = _quantize_templates(t_n, mesh)
+    else:
+        n_pad, t_pad = n, t_n
+    joint_upper: Optional[int] = None
+    _X_TT = {"sh_xinc", "ss_xinc", "port_conflict",
+             "aff_xinc", "anti_xinc", "eanti_xinc", "pref_xinc"}
+
     def encode_group(snap):
-        """(pbs, cfg, dnh, consts_list, sconsts, xconsts) for the CURRENT
-        snapshot — rebuilt after every eviction round, exactly like the
-        object path's rebuild_after_eviction + re-verdict pass."""
-        pbs_new = [enc.encode_problem(snap, t, profile,
-                                      ipa_extra_keys=extra_keys)
-                   for t in solve_templates]
+        """(pbs, cfg, dnh, consts_list, sconsts, xconsts, sc_np, xc_np, dt)
+        for the CURRENT snapshot — rebuilt after every eviction round,
+        exactly like the object path's rebuild_after_eviction + re-verdict
+        pass.  Everything is assembled in numpy and shipped with ONE device
+        transfer per const (sharded to the mesh specs when sharding), so
+        rebuilds never re-trace an eager-op lattice."""
+        nonlocal joint_upper
+        if snap is snapshot:
+            pbs_new = [pbs_all[i] for i in solve_idx]
+        else:
+            pbs_new = enc.encode_problems_shared(snap, solve_templates,
+                                                 profile,
+                                                 ipa_extra_keys=extra_keys)
         pbs, cfg, dnh = sweep_mod._pad_group(pbs_new)
         # the host-port gate rides the conflict matrix + tpl_placed, not
         # the cfg branch (whose single-template placed>0 rule would read
@@ -688,33 +823,74 @@ def solve_interleaved_tensor(snapshot: ClusterSnapshot,
             clone_has_ports=False,
             volume_self_conflict=any(pb.volume_self_conflict for pb in pbs),
             rwop_self_conflict=any(pb.rwop_self_conflict for pb in pbs))
-        consts_list = [sim.build_consts(pb, ss_dnh_min=dnh) for pb in pbs]
-        sconsts = {k: jnp.stack([c[k] for c in consts_list])
-                   for k in consts_list[0]}
+        consts_list = [sim.build_consts(pb, ss_dnh_min=dnh, device=False)
+                       for pb in pbs]
         dt = consts_list[0]["allocatable"].dtype
-        f = lambda a: jnp.asarray(a, dtype=dt)
-        xconsts = {
+        sc_np = {k: np.stack([c[k] for c in consts_list])
+                 for k in consts_list[0]}
+        f = lambda a: np.asarray(a, dtype=dt)
+        xc_np = {
             "sh_xinc": f(_spread_xinc(pbs, "spread_hard")),
             "ss_xinc": f(_spread_xinc(pbs, "spread_soft")),
             # static port conflicts vs EXISTING pods carry the curable
             # ports reason string (diagnose attributes static codes first)
-            "static_ports_fail": jnp.stack([
-                jnp.asarray(np.asarray(pb.static_code) == enc.CODE_PORTS)
-                for pb in pbs]),
-            "tier_rank": jnp.asarray(tier_rank),
-            "preempt_maybe": jnp.asarray(
+            "static_ports_fail": np.stack([
+                np.asarray(pb.static_code) == enc.CODE_PORTS for pb in pbs]),
+            "tier_rank": np.asarray(tier_rank),
+            "preempt_maybe": np.asarray(
                 maybe if preempt_on else np.zeros(t_n, dtype=bool)),
-            "ext_mask": jnp.asarray(ext_mask_np),
+            "ext_mask": ext_mask_np,
             "ext_bonus": f(ext_bonus_np),
             "port_conflict": f(_port_conflict_matrix(pbs)
                                if profile.filter_enabled("NodePorts")
                                else np.zeros((t_n, t_n))),
             **{k: f(v) for k, v in _ipa_xinc(pbs).items()},
         }
-        return pbs, cfg, dnh, consts_list, sconsts, xconsts, dt
+        if bounds:
+            # bracket the whole mix on the CURRENT snapshot: the sum of the
+            # per-template solo uppers (pure resource bounds — a joint run
+            # can only see less capacity per template) caps every future
+            # placement count, so hint_budget can right-size the scan; the
+            # guarded device auction degrades to its host recomputation on
+            # fault, never into this solve's fault ladder
+            from ..bounds.bracket import bracket_mix
+            joint, _claims, _deg = bracket_mix(pbs, mesh=mesh)
+            joint_upper = int(joint.upper)
+        if t_pad != t_n:
+            sc_np = {k: np.concatenate(
+                [v] + [v[-1:]] * (t_pad - t_n), axis=0)
+                for k, v in sc_np.items()}
+            xc_np = {
+                k: mesh_lib._pad_axis(
+                    mesh_lib._pad_axis(v, 0, t_pad, 0), 1, t_pad, 0)
+                if k in _X_TT else mesh_lib._pad_axis(v, 0, t_pad, 0)
+                for k, v in xc_np.items()}
+        if n_pad != n:
+            sc_out = {}
+            for k, v in sc_np.items():
+                ax = mesh_lib._NODE_AXIS_OF.get(k)
+                if ax is None:
+                    sc_out[k] = v
+                else:
+                    val = -1 if k in mesh_lib._PAD_NEG else (
+                        1 if k in mesh_lib._PAD_ONE else 0)
+                    sc_out[k] = mesh_lib._pad_axis(v, ax + 1, n_pad, val)
+            sc_np = sc_out
+            xc_np = {k: mesh_lib._pad_axis(v, 1, n_pad, 0)
+                     if k in _XCONSTS_NODE else v
+                     for k, v in xc_np.items()}
+        if mesh is not None:
+            sconsts = mesh_lib.shard_consts(mesh, sc_np, batched=True)
+            xsh = _xconsts_shardings(mesh, xc_np)
+            xconsts = {k: jax.device_put(v, xsh[k])
+                       for k, v in xc_np.items()}
+        else:
+            sconsts = {k: jnp.asarray(v) for k, v in sc_np.items()}
+            xconsts = {k: jnp.asarray(v) for k, v in xc_np.items()}
+        return pbs, cfg, dnh, consts_list, sconsts, xconsts, sc_np, xc_np, dt
 
-    pbs, cfg, dnh, consts_list, sconsts, xconsts, dt = encode_group(snap_cur)
-    f = lambda a: jnp.asarray(a, dtype=dt)
+    pbs, cfg, dnh, consts_list, sconsts, xconsts, sc_np, xc_np, dt = \
+        encode_group(snap_cur)
 
     # carry per-template clone counts at full [T, N] only when a gate
     # reads them (ports / inline disks) — otherwise a [1, 1] dummy saves a
@@ -723,81 +899,124 @@ def solve_interleaved_tensor(snapshot: ClusterSnapshot,
                     or pbs_all[i].volume_self_conflict
                     for i in solve_idx)
 
+    def _tp(a, fill=0):
+        """Pad a host queue vector from t_n to the quantized template axis
+        (pad templates stay inactive/parked-false forever)."""
+        a = np.asarray(a)
+        if t_pad == a.shape[0]:
+            return a
+        return np.concatenate(
+            [a, np.full((t_pad - a.shape[0],) + a.shape[1:], fill,
+                        dtype=a.dtype)])
+
     def fresh_xcarry(k_counts, active_np, parked_np, last_seq_np,
                      next_start_np, seq_next_v, quota_v):
         g = pbs[0].ipa.node_domain.shape[0]
         cs = pbs[0].spread_soft.node_domain.shape[0]
-        return XCarry(
-            requested=f(pbs[0].init_requested),
-            nonzero=f(pbs[0].init_nonzero),
+        host = XCarry(
+            requested=mesh_lib._pad_axis(
+                np.asarray(pbs[0].init_requested, dtype=dt), 0, n_pad, 0),
+            nonzero=mesh_lib._pad_axis(
+                np.asarray(pbs[0].init_nonzero, dtype=dt), 0, n_pad, 0),
             # per-template clone counts start at zero even after an
             # eviction rebuild: surviving clones are baked into the
             # re-encoded snapshot (static port masks included), exactly
             # like the carried spread/affinity counts
-            tpl_placed=jnp.zeros((t_n, n) if needs_tpl else (1, 1),
-                                 dtype=jnp.int32),
-            sh_cnt=sconsts["sh_cnt_init"],
-            ss_cnt=sconsts["ss_cnt_init"],
-            ssh_cnt=jnp.zeros((t_n, cs, n), dtype=dt),
-            aff_cnt=jnp.zeros((t_n, g, n), dtype=dt),
-            anti_cnt=jnp.zeros((t_n, g, n), dtype=dt),
-            eanti_cnt=jnp.zeros((t_n, g, n), dtype=dt),
-            pref_cnt=jnp.zeros((t_n, g, n), dtype=dt),
-            aff_total=jnp.zeros(t_n, dtype=dt),
-            k=jnp.asarray(k_counts, dtype=jnp.int32),
-            active=jnp.asarray(active_np),
-            parked_curable=jnp.asarray(parked_np),
-            last_seq=jnp.asarray(last_seq_np, dtype=jnp.int32),
-            next_start=jnp.asarray(next_start_np, dtype=jnp.int32),
-            seq_next=jnp.asarray(seq_next_v, jnp.int32),
-            quota=jnp.asarray(quota_v, jnp.int32),
-            halt=jnp.asarray(False),
-            halt_ti=jnp.asarray(0, jnp.int32))
+            tpl_placed=np.zeros((t_pad, n_pad) if needs_tpl else (1, 1),
+                                dtype=np.int32),
+            # fresh copies, not the sconsts buffers: the sharded runner
+            # donates the carry, and a donated buffer must never alias the
+            # consts (or the numpy slab behind a zero-copy device_put)
+            sh_cnt=sc_np["sh_cnt_init"].copy(),
+            ss_cnt=sc_np["ss_cnt_init"].copy(),
+            ssh_cnt=np.zeros((t_pad, cs, n_pad), dtype=dt),
+            aff_cnt=np.zeros((t_pad, g, n_pad), dtype=dt),
+            anti_cnt=np.zeros((t_pad, g, n_pad), dtype=dt),
+            eanti_cnt=np.zeros((t_pad, g, n_pad), dtype=dt),
+            pref_cnt=np.zeros((t_pad, g, n_pad), dtype=dt),
+            aff_total=np.zeros(t_pad, dtype=dt),
+            k=_tp(np.asarray(k_counts, dtype=np.int32)),
+            active=_tp(np.asarray(active_np, dtype=bool), False),
+            parked_curable=_tp(np.asarray(parked_np, dtype=bool), False),
+            last_seq=_tp(np.asarray(last_seq_np, dtype=np.int32)),
+            next_start=_tp(np.asarray(next_start_np, dtype=np.int32)),
+            seq_next=np.asarray(seq_next_v, dtype=np.int32),
+            quota=np.asarray(quota_v, dtype=np.int32),
+            halt=np.asarray(False),
+            halt_ti=np.asarray(0, dtype=np.int32))
+        if mesh is not None:
+            return jax.device_put(host, _xcarry_shardings(mesh, needs_tpl))
+        return jax.tree.map(jnp.asarray, host)
 
     def hint_budget(total_done: int) -> int:
         """Step allowance from NOW: the fit-bound hints of the CURRENT pbs
         (evictions free capacity, so this is recomputed per rebuild — the
-        pre-eviction hint would under-budget the preemptor's gains)."""
+        pre-eviction hint would under-budget the preemptor's gains).  With
+        bounds on, the mix's joint upper bound (recomputed per rebuild too)
+        right-sizes the allowance; since every reachable total stays
+        strictly under total_done + upper + 1, the race still always ends
+        by natural halts and the trajectory is bit-identical."""
         b = min(total_done + sum(pb.max_steps_hint for pb in pbs) + t_n + 1,
                 sim._DEFAULT_UNLIMITED_CAP)
+        if joint_upper is not None:
+            b = min(b, total_done + joint_upper + 1)
         if max_total:
             b = min(b, max_total)
         return b
 
+    # Bounds-guided skip: a template that fails STATICALLY on every node
+    # (solo bracket exact at upper == 0) can never place until an eviction
+    # rebuild, and its diagnosis is moment-independent (diagnose attributes
+    # static codes first) — so it starts parked with its result precomputed
+    # instead of burning a pop + chunk halt.  Preemption-capable templates
+    # keep the pop (the halt runs the DefaultPreemption PostFilter), and
+    # max_total runs keep it too (the race may end with the queue non-empty,
+    # where the reference classifies it LimitReached, not Unschedulable).
+    skip = np.zeros(t_n, dtype=bool)
+    if bounds and max_total == 0:
+        for ti in range(t_n):
+            if (not (preempt_on and maybe[ti])
+                    and np.all(np.asarray(pbs[ti].static_code)
+                               != enc.CODE_OK)):
+                skip[ti] = True
+
     budget = hint_budget(0)
-    xc = fresh_xcarry(np.zeros(t_n), np.ones(t_n, dtype=bool),
+    xc = fresh_xcarry(np.zeros(t_n), ~skip,
                       np.zeros(t_n, dtype=bool), np.arange(t_n),
                       np.zeros(t_n), t_n, budget)
 
     def view_of(ti: int):
-        own = xc.tpl_placed[ti] if needs_tpl \
+        """Single-template Carry view over the REAL node table: mesh pads
+        slice off so host diagnosis sees exactly the unpadded state (the
+        consts_list entries are per-template and unpadded)."""
+        own = xc.tpl_placed[ti, :n] if needs_tpl \
             else jnp.zeros(n, dtype=jnp.int32)
         return sim.Carry(
-            requested=xc.requested, nonzero=xc.nonzero,
+            requested=xc.requested[:n], nonzero=xc.nonzero[:n],
             placed=own,
-            sh_cnt=xc.sh_cnt[ti], ss_cnt=xc.ss_cnt[ti],
-            aff_cnt=xc.aff_cnt[ti], anti_cnt=xc.anti_cnt[ti],
-            pref_cnt=xc.pref_cnt[ti], aff_total=xc.aff_total[ti],
+            sh_cnt=xc.sh_cnt[ti, :, :n], ss_cnt=xc.ss_cnt[ti, :, :n],
+            aff_cnt=xc.aff_cnt[ti, :, :n], anti_cnt=xc.anti_cnt[ti, :, :n],
+            pref_cnt=xc.pref_cnt[ti, :, :n], aff_total=xc.aff_total[ti],
             placed_count=xc.k[ti], stopped=jnp.asarray(True),
             next_start=xc.next_start[ti], rng=jax.random.PRNGKey(0))
 
     def ports_blocked_of(ti: int):
         if not needs_tpl:
             return None
-        conflict = np.asarray(xconsts["port_conflict"])[ti]       # [T]
-        live = np.asarray(xc.tpl_placed) > 0                      # [T, N]
+        conflict = xc_np["port_conflict"][ti, :t_n]               # [T]
+        live = np.asarray(xc.tpl_placed)[:t_n, :n] > 0            # [T, N]
         return jnp.asarray(conflict @ live.astype(np.float64) > 0.5)
 
     def park_result(ti: int):
         counts = sim.diagnose(pbs[ti], cfg, consts_list[ti], view_of(ti),
-                              eanti_dyn=xc.eanti_cnt[ti],
+                              eanti_dyn=xc.eanti_cnt[ti, :, :n],
                               ports_blocked=ports_blocked_of(ti))
         if extenders:
             # nodes the in-tree filters accept can only have been lost to
             # the extender Filter chain — the object path attributes the
             # whole in-tree-feasible set to that bucket
             feas, _ = sim._feasibility(cfg, consts_list[ti], view_of(ti),
-                                       eanti_dyn=xc.eanti_cnt[ti],
+                                       eanti_dyn=xc.eanti_cnt[ti, :, :n],
                                        ports_blocked=ports_blocked_of(ti))
             n_feas = int(np.asarray(feas).sum())
             if n_feas:
@@ -812,8 +1031,26 @@ def solve_interleaved_tensor(snapshot: ClusterSnapshot,
             fail_counts=counts, node_names=snapshot.node_names)
         return counts
 
-    run = _xchunk_runner()
+    run = _xchunk_runner() if mesh is None else \
+        _xchunk_runner_sharded(mesh, sconsts, xconsts, needs_tpl)
     placements: List[List[int]] = [[] for _ in pbs]
+
+    if skip.any():
+        # precompute the skipped templates' diagnoses at the initial state
+        # (bit-identical to the reference's later halt: every node carries a
+        # static code, and diagnose attributes static codes first); a
+        # ports-curable skip stays parked_curable so placements re-enter it
+        # in-step exactly like the reference's first in-step re-park
+        parked0 = np.asarray(xc.parked_curable).copy()
+        redo = False
+        for ti in np.flatnonzero(skip):
+            counts = park_result(int(ti))
+            if set(counts) & sweep_mod._add_curable_reasons():
+                results[solve_idx[int(ti)]] = None
+                parked0[int(ti)] = True
+                redo = True
+        if redo:
+            xc = xc._replace(parked_curable=jnp.asarray(parked0))
     # Host object mirror for preemption rounds: the current truth of every
     # node's pod roster (snapshot pods + live clone dicts).  Clone dicts are
     # created ONCE at placement time (make_clone mints a fresh uid) so
@@ -843,7 +1080,7 @@ def solve_interleaved_tensor(snapshot: ClusterSnapshot,
         (pod-DELETE event), and put the preemptor at the front of its
         tier.  Returns True when an eviction happened."""
         nonlocal snap_cur, pbs, cfg, dnh, consts_list, sconsts, xconsts, \
-            xc, preempt_budget, front_seq, budget
+            sc_np, xc_np, xc, preempt_budget, front_seq, budget
         from ..engine.extenders import make_node_ok
         from ..engine.preemption import evaluate as preempt_evaluate
         from ..engine.preemption import victim_matcher
@@ -900,7 +1137,7 @@ def solve_interleaved_tensor(snapshot: ClusterSnapshot,
         front_seq -= 1
         next_start_np[ti] = 0
 
-        pbs, cfg, dnh, consts_list, sconsts, xconsts, _dt = \
+        pbs, cfg, dnh, consts_list, sconsts, xconsts, sc_np, xc_np, _dt = \
             encode_group(snap_cur)
         budget = hint_budget(total)
         xc = fresh_xcarry([len(p) for p in placements], active_np,
@@ -981,25 +1218,56 @@ def solve_interleaved_tensor(snapshot: ClusterSnapshot,
 def sweep_interleaved_auto(snapshot: ClusterSnapshot,
                            templates: Sequence[dict],
                            profile: Optional[SchedulerProfile] = None,
-                           max_total: int = 0) -> List[sim.SolveResult]:
+                           max_total: int = 0, *,
+                           mesh=None,
+                           bounds: Optional[bool] = None
+                           ) -> List[sim.SolveResult]:
     """Tensor engine when eligible, object-level queue loop otherwise.
 
-    The tensor dispatch runs under runtime/guard.run (irgate GD001); a
-    classified device fault degrades to the object-level parity loop —
-    the natural lower rung for the multi-template path — instead of
-    crashing the sweep.
+    With ``mesh`` the stacked-template scan runs sharded over the
+    {batch, nodes} device mesh (rung ``interleave_sharded``); a
+    classified device fault at ``parallel.interleave_sharded`` degrades
+    to the unsharded tensor path, and a fault there degrades further to
+    the object-level parity loop.  ``bounds`` defaults to True on the
+    sharded rung (bracket the mix, skip statically-infeasible templates,
+    right-size the scan budget) and False otherwise so legacy callers
+    see byte-identical behavior.  Each dispatch runs under
+    runtime/guard.run (irgate GD001).
     """
-    from ..runtime import faults, guard
+    from ..runtime import degrade, faults, guard
     from ..runtime.errors import RuntimeFault
+
+    bounds = (mesh is not None) if bounds is None else bounds
+    degraded = False
+    if mesh is not None:
+        try:
+            res = guard.run(solve_interleaved_tensor, snapshot, templates,
+                            profile, max_total=max_total,
+                            mesh=mesh, bounds=bounds,
+                            site=faults.SITE_INTERLEAVE_SHARDED,
+                            validate_nodes=snapshot.num_nodes,
+                            rung=degrade.RUNG_INTERLEAVE_SHARDED,
+                            batch=len(templates),
+                            mesh_shape=mesh_lib.mesh_shape(mesh))
+        except RuntimeFault as fault:
+            degrade._record(fault, degrade.RUNG_INTERLEAVE)
+            degraded = True
+            res = None          # degrade to the unsharded tensor path
+        if res is not None:
+            return [degrade._stamp(r, degrade.RUNG_INTERLEAVE_SHARDED,
+                                   False) for r in res]
 
     try:
         res = guard.run(solve_interleaved_tensor, snapshot, templates,
-                        profile, max_total=max_total,
+                        profile, max_total=max_total, bounds=bounds,
                         site=faults.SITE_INTERLEAVE,
                         validate_nodes=snapshot.num_nodes)
     except RuntimeFault:
         res = None              # degrade to the object-level queue loop
     if res is not None:
+        if degraded:
+            return [degrade._stamp(r, degrade.RUNG_INTERLEAVE, True)
+                    for r in res]
         return res
     from .sweep import sweep_interleaved
     return sweep_interleaved(snapshot, templates, profile,

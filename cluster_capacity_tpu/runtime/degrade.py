@@ -42,22 +42,37 @@ RUNG_BATCHED = "fused_batched"
 RUNG_FUSED = "fused"
 RUNG_FAST_PATH = "fast_path"
 RUNG_ORACLE = "oracle"
+# Multi-template ladder (parallel/interleave.sweep_interleaved_auto):
+# sharded stacked-template scan degrades to the unsharded tensor race,
+# then to the object-level queue loop.  These rungs stamp results but do
+# not join LADDER — worst_rung ranks the single-template ladder only.
+RUNG_INTERLEAVE_SHARDED = "interleave_sharded"
+RUNG_INTERLEAVE = "interleave"
 
 # Ladder order, highest (healthiest) first.
 LADDER = (RUNG_SHARDED, RUNG_BATCHED, RUNG_FUSED, RUNG_FAST_PATH,
           RUNG_ORACLE)
+INTERLEAVE_LADDER = (RUNG_INTERLEAVE_SHARDED, RUNG_INTERLEAVE)
 
 EVENT_DEGRADED = "SolveDegraded"
 
 
-def worst_rung(results) -> str:
-    """The lowest rung among a set of results ('' when none are stamped)."""
+def _worst_in(results, ladder) -> str:
     worst = -1
     for r in results:
         rung = getattr(r, "rung", "")
-        if rung in LADDER:
-            worst = max(worst, LADDER.index(rung))
-    return LADDER[worst] if worst >= 0 else ""
+        if rung in ladder:
+            worst = max(worst, ladder.index(rung))
+    return ladder[worst] if worst >= 0 else ""
+
+
+def worst_rung(results) -> str:
+    """The lowest rung among a set of results ('' when none are stamped).
+
+    Single-template LADDER rungs rank first; a result set served entirely
+    by the multi-template interleave ladder reports its own worst rung."""
+    return (_worst_in(results, LADDER)
+            or _worst_in(results, INTERLEAVE_LADDER))
 
 
 def _stamp(result, rung: str, degraded: bool):

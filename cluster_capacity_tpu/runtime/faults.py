@@ -54,8 +54,10 @@ SITE_EXTENDERS = "engine.extenders"
 SITE_INTERLEAVE = "parallel.interleave"
 SITE_BOUNDS = "bounds.bracket"
 SITE_SHARDED = "parallel.sharded"
+SITE_INTERLEAVE_SHARDED = "parallel.interleave_sharded"
 SITES = (SITE_SOLVE, SITE_FAST_PATH, SITE_ORACLE, SITE_GROUP,
-         SITE_EXTENDERS, SITE_INTERLEAVE, SITE_BOUNDS, SITE_SHARDED)
+         SITE_EXTENDERS, SITE_INTERLEAVE, SITE_BOUNDS, SITE_SHARDED,
+         SITE_INTERLEAVE_SHARDED)
 
 
 class SimulatedHang(Exception):

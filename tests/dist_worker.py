@@ -22,6 +22,25 @@ def main():
     mesh = dist.global_mesh()
     snapshot = dist.load_snapshot_distributed(snapshot_path)
 
+    if os.path.exists(snapshot_path + ".templates.json"):
+        # interleaved multi-template smoke: replicated host control on the
+        # local-device mesh (see distributed.interleave_on_mesh)
+        with open(snapshot_path + ".templates.json") as f:
+            templates = [default_pod(t) for t in json.load(f)]
+        results = dist.interleave_on_mesh(
+            snapshot, templates, SchedulerProfile.parity(),
+            max_total=max_limit)
+        if jax.process_index() == 0:
+            with open(out_path, "w") as f:
+                json.dump({"interleave": [
+                    {"placements": r.placements,
+                     "fail_type": r.fail_type,
+                     "fail_message": r.fail_message,
+                     "rung": getattr(r, "rung", "")} for r in results],
+                    "processes": jax.process_count(),
+                    "devices": len(jax.devices())}, f)
+        return
+
     with open(snapshot_path + ".pod.json") as f:
         pod = json.load(f)
     pb = enc.encode_problem(snapshot, default_pod(pod),

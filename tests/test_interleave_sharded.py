@@ -1,0 +1,178 @@
+"""Fleet-scale interleaved sweeps: the sharded stacked-template scan
+(parallel/interleave with mesh=...) vs the per-template tensor reference.
+
+Differential fuzz across random dead-node sets x bounds on/off x uneven
+node/template counts (pad-to-shard-multiple and pow2 template quantization
+always exercised), the parallel.interleave_sharded chaos drill proving
+bit-identical fallback to the unsharded tensor path, the warmup-compile
+ceiling (the old eager-op lattice cost 67 warmup recompiles; the cached
+sharded runner is pinned far below it), and zero steady recompiles at a
+fixed (mesh, static config)."""
+
+import jax
+import numpy as np
+import pytest
+
+from test_interleave_tensor import _assert_same, _nodes, _template
+
+from cluster_capacity_tpu.models.snapshot import ClusterSnapshot
+from cluster_capacity_tpu.parallel import interleave as il
+from cluster_capacity_tpu.parallel import mesh as mesh_lib
+from cluster_capacity_tpu.utils.config import SchedulerProfile
+
+needs_8 = pytest.mark.skipif(len(jax.devices()) < 8,
+                             reason="needs 8 virtual devices")
+
+# the sharded-runner warmup ceiling: bench r07 measured 67 warmup
+# recompiles on the old eager path; the cached runner + numpy assembly
+# must stay well under half of that
+WARMUP_COMPILE_CEILING = 40
+
+
+def _mesh():
+    return mesh_lib.make_mesh(n_node_shards=4, n_batch_shards=2)
+
+
+def _snap(n, seed=0, dead=()):
+    nodes = _nodes(n, seed=seed)
+    for i in dead:
+        nodes[i]["spec"]["unschedulable"] = True
+    return ClusterSnapshot.from_objects(nodes)
+
+
+def _mix(t_n, seed=0):
+    """Template mix with cross-template coupling: shared app labels put
+    every clone under the same spread/anti-affinity selectors."""
+    rng = np.random.RandomState(seed)
+    out = []
+    for i in range(t_n):
+        kw = {}
+        if i % 3 == 1:
+            kw["spread"] = (2, "topology.kubernetes.io/zone",
+                            {"team": "fuzz"})
+        if i % 3 == 2:
+            kw["pref_anti"] = (50, "kubernetes.io/hostname",
+                               {"team": "fuzz"})
+        out.append(_template(f"t{i}", int(rng.choice([300, 450, 600, 900])),
+                             mem_gi=int(rng.choice([0, 1])),
+                             labels={"app": f"t{i}", "team": "fuzz"}, **kw))
+    return out
+
+
+@needs_8
+@pytest.mark.parametrize("n_nodes,t_n", [(21, 3), (37, 5)])
+def test_sharded_interleave_fuzz(n_nodes, t_n):
+    """Differential fuzz: sharded == per-template-reference bit-identity
+    across random dead-node sets and bounds on/off, with node counts that
+    do not divide the 4 node shards and template counts that pow2-quantize
+    up (3->4, 5->8) — padding rows are always in play."""
+    prof = SchedulerProfile.parity()
+    mesh = _mesh()
+    rng = np.random.RandomState(n_nodes)
+    ts = _mix(t_n, seed=t_n)
+    for trial in range(2):
+        dead = tuple(rng.choice(n_nodes, size=rng.randint(0, 4),
+                                replace=False))
+        snap = _snap(n_nodes, seed=trial, dead=dead)
+        ref = il.solve_interleaved_tensor(snap, ts, prof)
+        for bounds in (False, True):
+            got = il.solve_interleaved_tensor(snap, ts, prof, mesh=mesh,
+                                              bounds=bounds)
+            _assert_same(ref, got, f"trial{trial} bounds={bounds}")
+
+
+@needs_8
+def test_sharded_interleave_max_total_parity():
+    """The pooled pod budget (LimitReached classification + message) must
+    survive sharding: budget exhaustion is a host-side decision reading
+    device scalars, identical on every rung."""
+    prof = SchedulerProfile.parity()
+    snap = _snap(21, seed=3)
+    ts = _mix(4, seed=4)
+    for max_total in (1, 17):
+        ref = il.solve_interleaved_tensor(snap, ts, prof,
+                                          max_total=max_total)
+        got = il.solve_interleaved_tensor(snap, ts, prof,
+                                          max_total=max_total, mesh=_mesh())
+        _assert_same(ref, got, f"max_total={max_total}")
+
+
+@needs_8
+def test_bounds_skip_static_fail_template_parity():
+    """bounds=True skips templates whose every node statically fails (the
+    bracket proves upper==0) — the skipped template's diagnosis must be
+    byte-identical to the reference that visits it in the scan."""
+    prof = SchedulerProfile.parity()
+    snap = _snap(21, seed=6)
+    ts = _mix(3, seed=7) + [_template("whale", 64000, mem_gi=1)]
+    ref = il.solve_interleaved_tensor(snap, ts, prof)
+    got = il.solve_interleaved_tensor(snap, ts, prof, bounds=True)
+    _assert_same(ref, got, "unsharded+bounds")
+    got = il.solve_interleaved_tensor(snap, ts, prof, mesh=_mesh(),
+                                      bounds=True)
+    _assert_same(ref, got, "sharded+bounds")
+
+
+@needs_8
+def test_chaos_drill_bit_identical_fallback():
+    """An injected fault at parallel.interleave_sharded degrades to the
+    unsharded tensor race with bit-identical results, stamped
+    rung=interleave / degraded=True; a clean sharded run stamps
+    rung=interleave_sharded / degraded=False."""
+    from cluster_capacity_tpu.runtime import degrade, faults
+
+    prof = SchedulerProfile.parity()
+    snap = _snap(21, seed=8)
+    ts = _mix(3, seed=9)
+    ref = il.sweep_interleaved_auto(snap, ts, prof)
+    with faults.inject("parallel.interleave_sharded:oom"):
+        res = il.sweep_interleaved_auto(snap, ts, prof, mesh=_mesh())
+    for a, b in zip(ref, res):
+        assert b.rung == degrade.RUNG_INTERLEAVE
+        assert b.degraded
+        assert a.placements == b.placements
+        assert a.fail_type == b.fail_type
+        assert a.fail_message == b.fail_message
+
+    clean = il.sweep_interleaved_auto(snap, ts, prof, mesh=_mesh())
+    for a, b in zip(ref, clean):
+        assert b.rung == degrade.RUNG_INTERLEAVE_SHARDED
+        assert not b.degraded
+        assert a.placements == b.placements
+        assert a.fail_message == b.fail_message
+
+
+@needs_8
+def test_legacy_entrypoint_unstamped():
+    """mesh=None callers must see the pre-sharding behavior byte-for-byte:
+    no rung stamps, no degraded flag, bounds defaulting off."""
+    prof = SchedulerProfile.parity()
+    snap = _snap(10, seed=2)
+    ts = _mix(3, seed=2)
+    res = il.sweep_interleaved_auto(snap, ts, prof)
+    for r in res:
+        assert getattr(r, "rung", "") == ""
+        assert not getattr(r, "degraded", False)
+
+
+@needs_8
+def test_warmup_ceiling_and_zero_steady_recompiles():
+    """One compile per (mesh, static config): the warmup tally stays under
+    the pinned ceiling (old eager path: 67) and re-solving fresh snapshots
+    of the SAME shapes triggers zero backend compiles."""
+    from cluster_capacity_tpu.obs import recompile as obs_recompile
+
+    prof = SchedulerProfile.parity()
+    mesh = _mesh()
+    ts = _mix(3, seed=11)
+    snap = _snap(21, seed=11)
+    with obs_recompile.CompileTally() as warm:
+        il.solve_interleaved_tensor(snap, ts, prof, mesh=mesh, bounds=True)
+    assert warm.count <= WARMUP_COMPILE_CEILING, warm.count
+
+    snap2 = _snap(21, seed=12)
+    with obs_recompile.CompileTally() as steady:
+        for _ in range(3):
+            il.solve_interleaved_tensor(snap2, ts, prof, mesh=mesh,
+                                        bounds=True)
+    assert steady.count == 0, f"{steady.count} steady recompiles"

@@ -775,6 +775,21 @@ def _flight_drill(site):
                 _group_pbs(),
                 mesh=mesh_lib.make_mesh(n_node_shards=1, n_batch_shards=1))
         return drive, (), False
+    if site == "parallel.interleave_sharded":
+        from cluster_capacity_tpu.parallel import mesh as mesh_lib
+        from cluster_capacity_tpu.parallel.interleave import (
+            sweep_interleaved_auto)
+
+        def drive():
+            snap = ClusterSnapshot.from_objects(
+                [build_test_node(f"n{i}", 2000, int(1e9), 8)
+                 for i in range(3)])
+            # degenerate 1x1 mesh: same sharded code path, any device count
+            sweep_interleaved_auto(
+                snap, [_probe(200, name="a"), _probe(300, name="b")],
+                max_total=4,
+                mesh=mesh_lib.make_mesh(n_node_shards=1, n_batch_shards=1))
+        return drive, (), False
     assert site == "bounds.bracket"
     from cluster_capacity_tpu import bounds
 

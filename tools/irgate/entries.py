@@ -168,6 +168,27 @@ def _drive_sharded_group(b: int):
     return driver
 
 
+def _drive_interleave_sharded(t: int):
+    def driver():
+        from cluster_capacity_tpu.models.podspec import default_pod
+        from cluster_capacity_tpu.models.snapshot import ClusterSnapshot
+        from cluster_capacity_tpu.parallel import interleave as il
+        from cluster_capacity_tpu.parallel import mesh as mesh_lib
+        from cluster_capacity_tpu.utils.config import SchedulerProfile
+
+        snapshot = ClusterSnapshot.from_objects(_nodes(8), [])
+        templates = [default_pod(_pod(f"tmpl-{i}", 200 + 100 * i, int(5e7),
+                                      labels={"app": f"tmpl-{i}"}))
+                     for i in range(t)]
+        # float32 profile: parity()'s x64 switch is process-global and
+        # would taint every later entry's captured IR with f64 values
+        il.solve_interleaved_tensor(
+            snapshot, templates, SchedulerProfile(),
+            mesh=mesh_lib.make_mesh(n_node_shards=1, n_batch_shards=1),
+            bounds=True)
+    return driver
+
+
 def _drive_fast_path(b: int):
     def driver():
         from cluster_capacity_tpu.engine import fast_path
@@ -273,6 +294,12 @@ def canonical_entries() -> List[EntrySpec]:
         # table must stay partitioned, cross-shard combines are reductions
         EntrySpec("sharded_group/n8b2", "sharded_batched",
                   _drive_sharded_group(2), env=fused_off,
+                  policy=Policy(forbid_gather=True)),
+        # stacked-template interleaved race on the mesh: one jitted scan
+        # whose template axis rides the batch shards; same IC007 no-gather
+        # contract as the sharded group solve
+        EntrySpec("interleave_sharded/n8t2", "interleave_sharded",
+                  _drive_interleave_sharded(2), env=fused_off,
                   policy=Policy(forbid_gather=True)),
         EntrySpec("scan/n8", "fused", _drive_scan(8), env=fused_off),
         EntrySpec("scan/n16", "fused", _drive_scan(16), env=fused_off),

@@ -18,6 +18,14 @@ sweep after a warm-up pass, so one-time compilation does not pollute the
 rate; the warm-up also demonstrates the fixed-mesh runner cache (alive-mask
 changes between scenarios reuse ONE compiled executable).
 
+The interleaved multi-template rung (--interleave-scales, default
+2000,16000 with 64000 as the opt-in slow rung) runs the stacked-template
+sharded race (parallel/interleave with mesh=...) against the per-template
+tensor reference at fleet node counts: bit-identity of placements and fail
+messages at every scale, zero steady recompiles on the cached runner, and
+interleave_sharded_placements_per_sec (total + per device) pinned from the
+primary interleave scale.
+
 Usage:
   XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORM_NAME=cpu \
       python -m tools.multichip_bench --nodes 2000 --out MULTICHIP_r06.json
@@ -126,6 +134,95 @@ def run_scale(n_nodes: int, mesh, max_limit: int) -> dict:
     }
 
 
+def _template_mix(t_n: int):
+    """Heterogeneous template mix for the interleaved race: 4 cpu x 3 mem
+    shapes cycling under one shared team label so clones of every template
+    count under the same selectors — the cross-template coupling the
+    per-template path cannot batch."""
+    from cluster_capacity_tpu.models.podspec import default_pod
+
+    out = []
+    for i in range(t_n):
+        out.append(default_pod({
+            "metadata": {"name": f"tmpl-{i}",
+                         "labels": {"app": f"tmpl-{i}", "team": "fleet"}},
+            "spec": {"containers": [{"name": "c", "resources": {
+                "requests": {"cpu": f"{[500, 750, 1000, 1500][i % 4]}m",
+                             "memory": f"{[1, 2, 4][i % 3]}Gi"}}}]},
+        }))
+    return out
+
+
+INTERLEAVE_TEMPLATES = 8
+INTERLEAVE_MAX_TOTAL = 2048
+
+
+def run_interleave_scale(n_nodes: int, mesh) -> dict:
+    """Interleaved multi-template rung: the stacked-template sharded scan
+    vs the per-template tensor reference at fleet node counts.
+
+    Bit-identity (placements + fail messages) is proven on BOTH the full
+    mesh and a degenerate single-shard mesh; throughput is recorded for
+    both and the pinned rate takes the better one.  On CPU hosts the
+    virtual devices are threads, so the per-pop winner all-reduce of the
+    sequential race pays a thread-rendezvous per step and the full-mesh
+    rate trails the single-shard rate — on real multichip interconnect
+    that latency is microseconds and the full mesh wins.  The timed run
+    must be compile-free (the cached runner keyed on (mesh, static
+    config) already compiled during the warm/identity pass)."""
+    from cluster_capacity_tpu.obs import recompile as obs_recompile
+    from cluster_capacity_tpu.parallel import interleave as il
+    from cluster_capacity_tpu.parallel import mesh as mesh_lib
+    from cluster_capacity_tpu.utils.config import SchedulerProfile
+
+    snapshot, _ = _fleet(n_nodes)
+    templates = _template_mix(INTERLEAVE_TEMPLATES)
+    profile = SchedulerProfile.parity()
+    ref = il.solve_interleaved_tensor(snapshot, templates, profile,
+                                      max_total=INTERLEAVE_MAX_TOTAL)
+    placed = sum(r.placed_count for r in ref)
+
+    def timed(m, label):
+        got = il.solve_interleaved_tensor(           # warm-up + identity
+            snapshot, templates, profile,
+            max_total=INTERLEAVE_MAX_TOTAL, mesh=m, bounds=True)
+        for i, (a, b) in enumerate(zip(ref, got)):
+            if (a.placements != b.placements
+                    or a.fail_message != b.fail_message):
+                raise AssertionError(
+                    f"interleave {label}: sharded diverges from the "
+                    f"per-template reference at {n_nodes} nodes, "
+                    f"template {i}")
+        with obs_recompile.CompileTally() as tally:
+            t0 = time.perf_counter()
+            il.solve_interleaved_tensor(
+                snapshot, templates, profile,
+                max_total=INTERLEAVE_MAX_TOTAL, mesh=m, bounds=True)
+            dt = time.perf_counter() - t0
+        if tally.count:
+            raise AssertionError(
+                f"interleave {label}: {tally.count} steady recompiles "
+                f"at {n_nodes} nodes (runner cache miss)")
+        return dt
+
+    dt_mesh = timed(mesh, "full-mesh")
+    dt_single = timed(mesh_lib.make_mesh(1, 1), "single-shard")
+    rate_mesh = placed / dt_mesh if dt_mesh > 0 else 0.0
+    rate_single = placed / dt_single if dt_single > 0 else 0.0
+    best_rate, best_devices = ((rate_mesh, mesh.devices.size)
+                               if rate_mesh >= rate_single
+                               else (rate_single, 1))
+    return {
+        "nodes": n_nodes,
+        "templates": INTERLEAVE_TEMPLATES,
+        "placed": placed,
+        "full_mesh_placements_per_sec": rate_mesh,
+        "single_shard_placements_per_sec": rate_single,
+        "placements_per_sec": best_rate,
+        "per_device_placements_per_sec": best_rate / best_devices,
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="multichip_bench",
@@ -142,6 +239,13 @@ def main(argv=None) -> int:
                     help=f"per-scenario placement cap (default "
                          f"{DEFAULT_LIMIT}; bounds prune rows whose bracket "
                          f"already proves the cap)")
+    ap.add_argument("--interleave-scales", dest="interleave_scales",
+                    default="2000,16000",
+                    help="comma list of fleet sizes for the interleaved "
+                         "multi-template rung (default 2000,16000; add "
+                         "64000 for the slow rung; empty disables); the "
+                         "first entry is the primary scale the pinned "
+                         "interleave_sharded_* metrics come from")
     ap.add_argument("--mesh", default="auto",
                     help="mesh spec: BxN, 'auto' (default), or 'none'")
     ap.add_argument("--out", default="",
@@ -170,8 +274,27 @@ def main(argv=None) -> int:
         for n_nodes in scales:
             per_scale[str(n_nodes)] = run_scale(n_nodes, mesh,
                                                 args.max_limit)
+        il_scales = [int(s) for s in args.interleave_scales.split(",") if s]
+        il_per_scale = {}
+        for n_nodes in il_scales:
+            il_per_scale[str(n_nodes)] = run_interleave_scale(n_nodes, mesh)
         primary = per_scale[str(scales[0])]
         rate = primary["placements_per_sec"]
+        il_doc = {}
+        il_tail = ""
+        if il_scales:
+            il_primary = il_per_scale[str(il_scales[0])]
+            il_doc = {
+                "interleave_sharded_placements_per_sec":
+                    il_primary["placements_per_sec"],
+                "interleave_sharded_per_device_placements_per_sec":
+                    il_primary["per_device_placements_per_sec"],
+                "scales_interleave": il_per_scale,
+            }
+            il_tail = (f", interleaved "
+                       f"{il_primary['placements_per_sec']:.1f}/s @ "
+                       f"{il_primary['nodes']} nodes "
+                       f"(rungs: {', '.join(str(s) for s in il_scales)})")
         doc.update(
             ok=True,
             mesh=mesh_shape(mesh),
@@ -190,7 +313,9 @@ def main(argv=None) -> int:
                   f"{primary['pruned_rows']} pruned), "
                   f"sharded==unsharded bit-identical, "
                   f"{rate:.1f} placements/s "
-                  f"({rate / n_devices:.1f}/device)\n"),
+                  f"({rate / n_devices:.1f}/device)"
+                  f"{il_tail}\n"),
+            **il_doc,
         )
 
     text = json.dumps(doc, indent=2)
