@@ -7,7 +7,7 @@ CXX ?= g++
 CXXFLAGS ?= -O2 -std=c++17 -fPIC -Wall
 NATIVE_LIB := cluster_capacity_tpu/models/libccsnap.so
 
-.PHONY: all build native lint test-unit test-parity test-fuzz test-dist test-integration test-e2e bench multichip perfgate compilegate trend chaos profile-smoke clean verify-native ci
+.PHONY: all build native lint test-unit test-parity test-fuzz test-dist test-integration test-e2e bench multichip perfgate compilegate trend chaos profile-smoke soak soak-smoke clean verify-native ci
 
 all: build
 
@@ -114,6 +114,20 @@ trend:
 # obs/flight.py).
 profile-smoke:
 	JAX_PLATFORMS=cpu $(PY) tools/profile_smoke.py
+
+# Chaos soak of the capacity daemon (tools/soak.py): serve.Supervisor
+# in-process under randomized fault injection + scripted snapshot churn,
+# continuously asserting same-rung bit-identity, zero steady-state
+# recompiles, breaker open/recover-within-cooldown, one flight bundle per
+# classified fault, and bounded thread/ring/memo growth.  Writes
+# SOAK_r07.json for tools/trend and perfgate's informational soak floors
+# (PG006).  soak-smoke is the ~60s CI-sized run; the full soak turns the
+# steady loop up.
+soak:
+	JAX_PLATFORMS=cpu $(PY) -m tools.soak
+
+soak-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m tools.soak --smoke
 
 # Full CI pipeline: lint + native + default suite + fuzz slice +
 # integration + multichip dryrun, as configured in ci.yaml (the

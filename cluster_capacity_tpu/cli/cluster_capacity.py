@@ -150,7 +150,15 @@ def build_parser(prog: str = "cluster-capacity") -> argparse.ArgumentParser:
                    help="Exit nonzero (status 3) when any solve was served "
                         "by a degraded ladder rung instead of the healthy "
                         "device path.  With --watch/--period the loop stops "
-                        "at the first degraded run.")
+                        "at the first degraded run past the --strict-after "
+                        "grace.")
+    p.add_argument("--strict-after", dest="strict_after", type=int, default=0,
+                   metavar="N",
+                   help="With --strict: tolerate degraded runs during the "
+                        "first N iterations (warmup grace — a cold compile "
+                        "overrunning a deadline degrades exactly once); the "
+                        "first degraded run AFTER iteration N exits 3.  "
+                        "Default 0: no grace.")
     p.add_argument("--interleave", action="store_true",
                    help="With multiple --podspec: race the templates through "
                         "ONE shared cluster state with scheduling-queue pop "
@@ -386,7 +394,7 @@ def run(argv: Optional[List[str]] = None, prog: str = "cluster-capacity") -> int
     if args.watch and args.period <= 0:
         args.period = 10.0
     runs = 0
-    any_degraded = False
+    strict_violated = False
     with contextlib.ExitStack() as stack:
         if args.profile_out:
             from ..obs import profile as obs_profile
@@ -397,14 +405,17 @@ def run(argv: Optional[List[str]] = None, prog: str = "cluster-capacity") -> int
                 from ..obs import flight
                 review.flight_bundles = flight.bundle_paths()
             print_review(review, verbose=args.verbose, fmt=args.output)
-            any_degraded = any_degraded or review.degraded
+            runs += 1
+            # --strict-after N: degraded runs within the first N iterations
+            # are warmup grace; only a degraded run past the grace violates
+            if review.degraded and runs > args.strict_after:
+                strict_violated = True
             if args.metrics:
                 from ..utils.metrics import default_registry
                 sys.stderr.write(default_registry.render())
-            runs += 1
-            if args.strict and any_degraded:
+            if args.strict and strict_violated:
                 # --strict must not wait for a watch loop that may never
-                # exit: the first degraded run ends the loop, returns 3
+                # exit: the first violating run ends the loop, returns 3
                 break
             if args.period <= 0:
                 break
@@ -422,7 +433,7 @@ def run(argv: Optional[List[str]] = None, prog: str = "cluster-capacity") -> int
         out_path = os.path.join(args.profile_out, "attribution.json")
         obs_profile.write_attribution(out_path)
         print(f"profile: attribution written to {out_path}", file=sys.stderr)
-    if args.strict and any_degraded:
+    if args.strict and strict_violated:
         if args.flight_dir:
             from ..obs import flight
             flight.on_strict(f"--strict: solve served by degraded ladder "

@@ -17,6 +17,7 @@ from . import explain as explain_cli
 from . import genpod as genpod_cli
 from . import profile as profile_cli
 from . import resilience as resilience_cli
+from . import serve as serve_cli
 
 _COMMANDS = {
     "cluster-capacity": cc_cli.run,
@@ -24,6 +25,7 @@ _COMMANDS = {
     "resilience": resilience_cli.run,
     "explain": explain_cli.run,
     "profile": profile_cli.run,
+    "serve": serve_cli.run,
 }
 
 
@@ -47,7 +49,9 @@ def run(argv: Optional[List[str]] = None) -> int:
           "  explain            why-not / why-here / bottleneck attribution "
           "for one solve\n"
           "  profile            device-time/memory attribution + cost-model "
-          "calibration under capture\n",
+          "calibration under capture\n"
+          "  serve              crash-tolerant capacity daemon: supervised "
+          "serving with breakers + delta ingestion\n",
           file=sys.stderr)
     return 0 if argv and argv[0] in ("-h", "--help") else 1
 

@@ -447,9 +447,12 @@ def encode_problem(snapshot: ClusterSnapshot, pod: dict,
     )
 
 
+_SHARED_MEMO_CAP = 8
+
+
 def encode_problems_shared(snapshot: ClusterSnapshot,
                            templates, profile: SchedulerProfile,
-                           ipa_extra_keys=()):
+                           ipa_extra_keys=(), alive_mask=None):
     """Group-encode ``templates`` against one snapshot, memoised on it.
 
     The interleaved race re-derives the SAME template list from the same
@@ -460,15 +463,34 @@ def encode_problems_shared(snapshot: ClusterSnapshot,
     callers that rebuild snapshots after eviction pass brand-new snapshot
     objects whose memo store starts empty, so staleness cannot leak
     across rebuilds.
+
+    ``alive_mask`` folds failed nodes into the encoding (bool[n], see
+    encode_problem); it keys the memo by VALUE (bytes), because the serving
+    daemon flips the mask on node churn while keeping the snapshot — and
+    therefore every tensor shape and jit cache — intact.  An all-alive mask
+    normalizes to None so masked and unmasked callers share entries.  The
+    store is LRU-capped so a daemon cycling through many masks cannot grow
+    a snapshot's memo without bound.
     """
     store = snapshot.memo(("encode_problems_shared",), list)
     keys = tuple(ipa_extra_keys)
-    for tpls, prof, ks, pbs in store:
-        if (prof is profile and ks == keys
+    alive = None
+    alive_key = None
+    if alive_mask is not None:
+        alive = np.asarray(alive_mask, dtype=bool)
+        if alive.all():
+            alive = None
+        else:
+            alive_key = alive.tobytes()
+    for i, (tpls, prof, ks, ak, pbs) in enumerate(store):
+        if (prof is profile and ks == keys and ak == alive_key
                 and len(tpls) == len(templates)
                 and all(a is b for a, b in zip(tpls, templates))):
+            store.append(store.pop(i))  # LRU touch
             return pbs
-    pbs = [encode_problem(snapshot, t, profile, ipa_extra_keys=keys)
+    pbs = [encode_problem(snapshot, t, profile, ipa_extra_keys=keys,
+                          alive_mask=alive)
            for t in templates]
-    store.append((list(templates), profile, keys, pbs))
+    store.append((list(templates), profile, keys, alive_key, pbs))
+    del store[:-_SHARED_MEMO_CAP]
     return pbs

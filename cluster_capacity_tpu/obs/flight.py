@@ -18,7 +18,12 @@ failure — dumps one bundle directory:
 
 The recorder is bounded (oldest bundles pruned beyond ``max_bundles``),
 re-entrancy-guarded (a fault raised while dumping never recurses), and
-never lets a dump failure mask the fault being raised.
+never lets a dump failure mask the fault being raised.  Dump + prune run
+under a module lock so coalesced requests faulting on concurrent threads
+serialize their bundles instead of colliding on the sequence number or
+double-pruning the directory; the ``in_dump`` flag still catches same-thread
+recursion (the IR re-capture re-drives real solves), which the re-entrant
+lock would happily allow.
 
 The repro line synthesizes a ``CC_INJECT_FAULT`` spec from the fault's
 site + code, so re-running it deterministically re-triggers the same fault
@@ -37,6 +42,7 @@ import os
 import platform as platform_mod
 import shlex
 import sys
+import threading
 import time
 from typing import Any, Dict, List, Optional
 
@@ -79,8 +85,13 @@ _state: Dict[str, Any] = {
     "in_dump": False,
     "seq": 0,
     "bundles": [],           # paths dumped this process, oldest first
-    "degradations": [],      # ladder transitions noted since install
+    "degradations": [],      # ladder + breaker transitions noted since install
 }
+
+# Serializes dump + prune across threads.  RLock (not Lock) because the dump
+# path may classify a *new* fault on the same thread (IR re-capture drives
+# real solves); that recursion is cut by `in_dump`, not by deadlocking here.
+_dump_lock = threading.RLock()
 
 
 def install(directory: str, *, argv: Optional[List[str]] = None,
@@ -124,20 +135,36 @@ def on_degradation(fault, next_rung: str) -> None:
     del ring[:-64]
 
 
+def on_breaker(site: str, rung: str, old_state: str, new_state: str) -> None:
+    """serve/breaker's hook: note a circuit-breaker transition so the next
+    bundle's manifest shows the breaker history alongside ladder moves."""
+    if _state["config"] is None:
+        return
+    ring = _state["degradations"]
+    ring.append(f"breaker {site}/{rung}: {old_state} -> {new_state}")
+    del ring[:-64]
+
+
 def on_fault(fault) -> Optional[str]:
     """guard._record_fault_event's hook: dump a bundle for a classified
     fault.  Returns the bundle path, or None (not installed / re-entrant /
-    dump failed — failures are reported to stderr, never raised)."""
+    dump failed — failures are reported to stderr, never raised).  Safe to
+    call from concurrent threads: dumps serialize on a module lock."""
     if _state["config"] is None or _state["in_dump"]:
         return None
-    _state["in_dump"] = True
-    try:
-        return _dump(fault)
-    except Exception as exc:
-        sys.stderr.write(f"obs.flight: bundle dump failed: {exc}\n")
-        return None
-    finally:
-        _state["in_dump"] = False
+    with _dump_lock:
+        # re-check under the lock: another thread may have uninstalled the
+        # recorder while we waited, and same-thread recursion re-enters here
+        if _state["config"] is None or _state["in_dump"]:
+            return None
+        _state["in_dump"] = True
+        try:
+            return _dump(fault)
+        except Exception as exc:
+            sys.stderr.write(f"obs.flight: bundle dump failed: {exc}\n")
+            return None
+        finally:
+            _state["in_dump"] = False
 
 
 class _StrictFailure:

@@ -23,6 +23,17 @@ Counters:
         device occupancy because dispatch is serialized through guard.run
     cc_flight_bundles_total{code}                 flight-recorder bundles
         dumped per fault code (obs/flight.py)
+    cc_serve_requests_total{outcome}              daemon answers by outcome:
+        "ok", "degraded" (served off the entry rung), "error" (request
+        failed but the daemon survived) — serve/supervisor.py
+    cc_serve_coalesced_total                      requests answered by another
+        request's device solve (same-template dedup in a drain)
+    cc_serve_deltas_total{op,outcome}             snapshot deltas by op and
+        "applied"/"quarantined" (serve/ingest.py)
+    cc_serve_restarts_total                       worker-state crash-restarts
+        after an unclassified request failure
+    cc_breaker_transitions_total{site,from,to}    circuit-breaker state
+        transitions (serve/breaker.py)
 
 Gauges:
     cc_sweep_templates                    templates in the current sweep
@@ -36,6 +47,8 @@ Gauges:
         memory sampling is enabled)
     cc_kernel_efficiency{entry,rung}      measured FLOPs rate / calibrated
         platform rate per irgate ladder entry (obs/costmodel.py)
+    cc_breaker_state{site,rung}           circuit-breaker state per guarded
+        site: 0 closed, 1 open, 2 half-open (serve/breaker.py)
 
 Histograms:
     cc_guard_run_duration_seconds{site,rung,phase}   per-dispatch wall time
@@ -58,3 +71,9 @@ DEVICE_SECONDS = "cc_device_seconds_total"
 DEVICE_PEAK_BYTES = "cc_device_peak_bytes"
 KERNEL_EFFICIENCY = "cc_kernel_efficiency"
 FLIGHT_BUNDLES = "cc_flight_bundles_total"
+SERVE_REQUESTS = "cc_serve_requests_total"
+SERVE_COALESCED = "cc_serve_coalesced_total"
+SERVE_DELTAS = "cc_serve_deltas_total"
+SERVE_RESTARTS = "cc_serve_restarts_total"
+BREAKER_STATE = "cc_breaker_state"
+BREAKER_TRANSITIONS = "cc_breaker_transitions_total"

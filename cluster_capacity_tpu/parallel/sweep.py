@@ -484,7 +484,16 @@ def _batched_solve(pbs: List[enc.EncodedProblem], max_limit: int,
 
     placements: List[List[int]] = [[] for _ in pbs]
     steps_done = 0
+    # Quantize the chunk length up to a power of two: `n` is a static arg of
+    # the chunk runner, so without this every budget wobble (the serving
+    # daemon's pod churn moves the capacity upper bound a little each drain)
+    # would retrace the jit.  Bit-identity is preserved — the budget already
+    # exceeds every template's provable saturation, so steps past it place
+    # nothing (the loop below stops on all_stopped), and a max_limit-bound
+    # budget is re-trimmed after the loop.
     chunk = min(1024, budget)
+    if chunk > 1:
+        chunk = 1 << (chunk - 1).bit_length()
     bstate = None
     while steps_done < budget:
         if bfused is not None:

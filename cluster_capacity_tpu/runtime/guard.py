@@ -15,18 +15,28 @@ feeding the site×rung metrics, and every classified fault is stamped into
 the event recorder before it propagates.
 
 Deadline mechanics: JAX dispatch cannot be interrupted from Python, so the
-call runs in a daemon thread and on timeout the thread is *abandoned* — it
+call runs on a watchdog thread and on timeout the thread is *abandoned* — it
 may still complete in the background, but its result is discarded and the
 supervisor moves down the ladder.  That is the standard watchdog trade-off;
 the alternative (no deadline) wedges the whole sweep on one pathological
 compile.  Deadlines default to off (0) so the healthy path adds no thread
 hop.
+
+Watchdog threads are POOLED: a healthy deadline call borrows an idle worker
+and returns it, so a long-running daemon issuing thousands of guarded
+requests keeps a handful of threads alive instead of churning one per call
+(the old per-call ``threading.Thread`` leaked ~1 thread of stack bookkeeping
+per dispatch under `serve/`).  Only a timed-out worker is abandoned — it
+exits on its own once the wedged call finishes.  ``watchdog_threads()``
+exposes the live count for the soak harness's thread-bound assertion.
 """
 
 from __future__ import annotations
 
+import itertools
+import queue
 import threading
-from typing import Iterable, Optional
+from typing import Iterable, List, Optional
 
 from . import faults
 from .errors import (CompileTimeout, DeviceOOM, ExecuteTimeout,
@@ -92,28 +102,80 @@ def validate_result(result, num_nodes: int, *, site: str = "") -> None:
                 site=site)
 
 
+class _Watchdog(threading.Thread):
+    """A reusable deadline worker: accepts one job at a time over a queue,
+    posts (ok|err, value) back, and loops.  A caller that times out marks the
+    worker `abandoned` and never reuses it; the worker notices after the
+    wedged call finally returns (or via the sentinel below) and exits."""
+
+    _ids = itertools.count()
+
+    def __init__(self):
+        super().__init__(
+            name=f"cc-guard-watchdog-{next(self._ids)}", daemon=True)
+        self.jobs: "queue.Queue" = queue.Queue(maxsize=1)
+        self.results: "queue.Queue" = queue.Queue(maxsize=1)
+        self.abandoned = False
+        self.start()
+
+    def run(self):
+        while True:
+            job = self.jobs.get()
+            if job is None:  # retirement sentinel
+                return
+            fn, args, kwargs = job
+            try:
+                out = ("ok", fn(*args, **kwargs))
+            except BaseException as exc:  # re-raised on the caller's thread
+                out = ("err", exc)
+            self.results.put(out)
+            if self.abandoned:
+                return
+
+
+_MAX_IDLE_WATCHDOGS = 4
+_idle_watchdogs: List["_Watchdog"] = []
+_watchdog_lock = threading.Lock()
+
+
+def watchdog_threads() -> int:
+    """Live watchdog threads, pooled + abandoned.  The soak harness asserts
+    this stays bounded over thousands of deadline-guarded requests."""
+    return sum(1 for t in threading.enumerate()
+               if t.name.startswith("cc-guard-watchdog-"))
+
+
 def _deadline_call(fn, args, kwargs, deadline: float, *,
                    site: str, phase: str):
-    box = {}
-
-    def _target():
+    with _watchdog_lock:
+        worker = _idle_watchdogs.pop() if _idle_watchdogs else None
+    if worker is None or not worker.is_alive():
+        worker = _Watchdog()
+    worker.jobs.put((fn, args, kwargs))
+    try:
+        kind, value = worker.results.get(timeout=deadline)
+    except queue.Empty:
+        worker.abandoned = True
+        # If the worker already posted its (late) result and looped back to
+        # jobs.get() before seeing the flag, this sentinel unblocks it so the
+        # thread still exits instead of waiting for a job that never comes.
         try:
-            box["result"] = fn(*args, **kwargs)
-        except BaseException as exc:  # re-raised on the caller's thread
-            box["error"] = exc
-
-    thread = threading.Thread(
-        target=_target, name=f"cc-guard-{site}", daemon=True)
-    thread.start()
-    thread.join(deadline)
-    if thread.is_alive():
+            worker.jobs.put_nowait(None)
+        except queue.Full:
+            pass
         fault = CompileTimeout if phase == PHASE_COMPILE else ExecuteTimeout
         raise fault(
             f"device call exceeded {deadline:g}s wall-clock deadline "
             f"(worker thread abandoned)", site=site)
-    if "error" in box:
-        raise box["error"]
-    return box.get("result")
+    with _watchdog_lock:
+        if len(_idle_watchdogs) < _MAX_IDLE_WATCHDOGS:
+            _idle_watchdogs.append(worker)
+            worker = None
+    if worker is not None:
+        worker.jobs.put(None)  # pool full: retire
+    if kind == "err":
+        raise value
+    return value
 
 
 def _record_fault_event(fault) -> None:

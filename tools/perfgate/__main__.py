@@ -5,8 +5,9 @@ committed BENCH_r*.json, numerically sorted) against the committed
 throughput floors in tools/perfgate/pins.json — the pins are platform-keyed
 (one slot per platform), and the rate keys of the latest MULTICHIP_r*.json
 (the mesh-sharded sweep bench) fold into the comparison when its platform
-matches.  Exit 0 = clean or skipped (unpinned platform / no artifacts
-yet), 1 = findings.
+matches, and the latest SOAK_r*.json (the capacity-daemon chaos soak) is
+checked against the informational PG006 soak floors.  Exit 0 = clean or
+skipped (unpinned platform / no artifacts yet), 1 = findings.
 
 Flags:
 
@@ -146,6 +147,15 @@ def main(argv=None) -> int:
             info = gate.efficiency_findings(
                 json.load(fh), pins,
                 platform=bench.get("platform", "unknown"))
+    # latest committed chaos-soak artifact vs the informational soak
+    # floors (PG006) — like the multichip fold, only in committed-artifact
+    # mode, and only when the platform matches the gated bench
+    soak_paths = gate.soak_files() if fold_multichip else []
+    if soak_paths:
+        sdoc = gate.load_bench(soak_paths[-1])
+        if sdoc.get("platform") == bench.get("platform"):
+            info.extend(gate.soak_findings(
+                sdoc, pins, platform=bench.get("platform", "unknown")))
     doc = {
         "perfgate": 1,
         "bench": bench_label,

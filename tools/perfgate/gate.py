@@ -27,6 +27,14 @@ Rules:
          scenario reports ``steady_recompiles`` > 0 — compile work leaking
          past warmup into the measured region is a compile-budget
          violation even before it moves a throughput floor
+  PG006  (informational only — never fails the gate) chaos-soak serving
+         numbers from the latest ``SOAK_r*.json`` (tools/soak.py) vs the
+         optional per-platform ``soak_floors`` pins: ``*_per_sec`` keys
+         are floors (the daemon's sustained serving rate), every other
+         pinned key is a ceiling (breaker-recovery seconds, latency
+         milliseconds).  Like PG004 the numbers ride whatever host ran
+         the soak, so the finding informs — the soak CI step itself
+         gates the invariants
 
 Pins are platform-keyed: ``pins.json`` holds a ``platforms`` map with one
 slot per platform (cpu, tpu, ...), each carrying its own source, metric
@@ -117,6 +125,14 @@ def multichip_files(root: str = ROOT) -> List[str]:
         glob.glob(os.path.join(root, "MULTICHIP_r*.json")),
         key=lambda p: (int(m.group(1)) if (m := re.search(
             r"MULTICHIP_r(\d+)\.json$", p)) else -1, p))
+
+
+def soak_files(root: str = ROOT) -> List[str]:
+    """Committed SOAK_r*.json chaos-soak artifacts, numerically sorted."""
+    return sorted(
+        glob.glob(os.path.join(root, "SOAK_r*.json")),
+        key=lambda p: (int(m.group(1)) if (m := re.search(
+            r"SOAK_r(\d+)\.json$", p)) else -1, p))
 
 
 def merge_rates(bench: Dict[str, Any],
@@ -236,6 +252,9 @@ def make_pins(bench: Dict[str, Any], source: str,
     prev_slot = platforms.get(platform) or {}
     if isinstance(prev_slot.get("efficiency_floors"), dict):
         slot["efficiency_floors"] = dict(prev_slot["efficiency_floors"])
+    # the informational soak floors (PG006) are hand-curated too
+    if isinstance(prev_slot.get("soak_floors"), dict):
+        slot["soak_floors"] = dict(prev_slot["soak_floors"])
     if compile_budgets:
         slot["compile_budgets"] = {
             k: float(v) for k, v in sorted(compile_budgets.items())}
@@ -391,6 +410,55 @@ def efficiency_findings(calibration: Optional[Dict[str, Any]],
                 f"kernel efficiency {eff:.3f} below informational floor "
                 f"{floor:g} (calibration: obs/costmodel.py via "
                 f"`hypercc profile`; does not fail the gate)"))
+    return out
+
+
+def soak_findings(soak: Optional[Dict[str, Any]],
+                  pins: Optional[Dict[str, Any]],
+                  platform: Optional[str] = None) -> List[PerfFinding]:
+    """PG006, informational only: the latest chaos-soak artifact
+    (tools/soak.py's SOAK_r*.json) vs the optional per-platform
+    ``soak_floors`` pins.  ``*_per_sec`` keys are floors — the daemon's
+    sustained serving rate under fault injection and churn; every other
+    pinned key is a ceiling (breaker-recovery seconds, latency
+    milliseconds).  Like PG004 these ride whatever host ran the soak, so
+    the caller prints them but they NEVER affect the gate's exit code;
+    the soak CI step gates the invariants itself.  A committed artifact
+    whose ``ok`` flag is false is surfaced here too."""
+    pins = _normalize_pins(pins)
+    slots = (pins or {}).get("platforms") or {}
+    floors: Dict[str, Any] = {}
+    for name in sorted(slots) if platform is None else [platform]:
+        floors.update((slots.get(name) or {}).get("soak_floors") or {})
+    soak = soak or {}
+    out: List[PerfFinding] = []
+    if soak and not soak.get("ok", True):
+        n = len(soak.get("failures") or [])
+        out.append(PerfFinding(
+            "soak", "PG006",
+            f"committed soak artifact records {n} invariant violation(s) "
+            f"(tools/soak.py; does not fail this gate — the soak CI step "
+            f"gates itself)"))
+    for name in sorted(floors):
+        pin = floors[name]
+        got = soak.get(name)
+        if not isinstance(pin, (int, float)) \
+                or not isinstance(got, (int, float)) \
+                or isinstance(got, bool):
+            continue
+        if name.endswith("_per_sec"):
+            if got < pin:
+                out.append(PerfFinding(
+                    name, "PG006",
+                    f"soak serving rate {got:.2f}/s below informational "
+                    f"floor {pin:g}/s (chaos soak, host-dependent; does "
+                    f"not fail the gate)"))
+        elif got > pin:
+            out.append(PerfFinding(
+                name, "PG006",
+                f"soak measured {got:.3f} above informational ceiling "
+                f"{pin:g} (chaos soak, host-dependent; does not fail the "
+                f"gate)"))
     return out
 
 

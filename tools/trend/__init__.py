@@ -2,7 +2,8 @@
 
 Every CI round leaves numbered artifacts at the repo root — BENCH_rNN.json
 (bench.py's parsed metric line), MULTICHIP_rNN.json (the multi-device
-dry-run verdict) — and the gates can add their --json-out reports
+dry-run verdict), SOAK_rNN.json (the capacity daemon's chaos-soak
+verdict + serving rates) — and the gates can add their --json-out reports
 (IRGATE.json, PERFGATE.json).  This tool merges them into ONE per-metric
 trend table across rounds, so a reviewer reads the whole performance
 history in a glance instead of diffing five JSON files, and flags
@@ -120,6 +121,20 @@ def collect(root: str = ROOT) -> dict:
         for k, v in doc.items():
             if k in ("rc", "n_devices", "ok", "skipped") \
                     or k in _NON_METRIC_KEYS:
+                continue
+            put(k, rnd, v)
+
+    for rnd, path in _artifact_files(root, "SOAK_r*.json"):
+        doc = _load(path)
+        if not doc or doc.get("skipped"):
+            continue
+        # chaos-soak rounds (tools/soak.py): the daemon's sustained q/s,
+        # latency percentiles, fault/recovery counts; soak_ok is the
+        # invariant verdict.  Envelope/provenance keys stay out.
+        put("soak_ok", rnd, bool(doc.get("ok")))
+        for k, v in doc.items():
+            if k in ("soak", "rc", "ok", "skipped", "seed", "nodes",
+                     "steady_iterations") or k in _NON_METRIC_KEYS:
                 continue
             put(k, rnd, v)
 
