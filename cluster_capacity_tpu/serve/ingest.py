@@ -226,7 +226,9 @@ class SnapshotStore:
         nodes = [dict(n) for n in self.snapshot.nodes] + [dict(node)]
         pods = [dict(p) for plist in self.snapshot.pods_by_node
                 for p in plist]
-        rebuilt = ClusterSnapshot.from_objects(nodes, pods)
+        extra = {k: list(getattr(self.snapshot, k))
+                 for k in snap_mod.OBJECT_FIELDS}
+        rebuilt = ClusterSnapshot.from_objects(nodes, pods, **extra)
         # the node axis changed: carry the alive bits over by name (the new
         # node starts alive), and expect the next solve to recompile
         alive_by_name = dict(zip(self.snapshot.node_names, self.alive))

@@ -167,7 +167,7 @@ class Supervisor:
         self._visited.clear()
         classes = self._coalesce(reqs, pbs)
         results = self._dispatch(classes)
-        self._probe_stale([cls[0][1] for cls in classes])
+        self._probe_stale(classes)
 
         elapsed = self.config.clock() - t0
         answers: List[Answer] = []
@@ -213,27 +213,27 @@ class Supervisor:
         limits = {cls[0][0].max_limit for cls in classes}
         if len(classes) > 1 and len(limits) == 1 and self._groupable(reps):
             try:
-                results = self._solve_group_supervised(
+                return self._solve_group_supervised(
                     reps, max_limit=limits.pop())
-                return [(r, None) for r in results]
-            except RuntimeFault as fault:
-                return [(None, f"{fault.code}: {fault}")] * len(classes)
             except Exception as exc:
                 self._restart_worker(
                     reps, f"group solve died: {exc}")
                 return [(None, f"{type(exc).__name__}: {exc}")] * len(classes)
-        out = []
-        for cls in classes:
-            req, pb = cls[0]
-            try:
-                out.append((self._solve_one_supervised(
-                    pb, max_limit=req.max_limit), None))
-            except RuntimeFault as fault:
-                out.append((None, f"{fault.code}: {fault}"))
-            except Exception as exc:
-                self._restart_worker((pb,), f"solve died: {exc}")
-                out.append((None, f"{type(exc).__name__}: {exc}"))
-        return out
+        return [self._solve_item(cls[0][1], max_limit=cls[0][0].max_limit)
+                for cls in classes]
+
+    def _solve_item(self, pb, max_limit: int = 0, degraded: bool = False):
+        """(result, error) for one problem, faults contained per item: a
+        ladder-exhausting RuntimeFault or an unclassified crash answers ONLY
+        this signature class, never its drain-mates."""
+        try:
+            return (self._solve_one_supervised(
+                pb, max_limit=max_limit, degraded=degraded), None)
+        except RuntimeFault as fault:
+            return (None, f"{fault.code}: {fault}")
+        except Exception as exc:
+            self._restart_worker((pb,), f"solve died: {exc}")
+            return (None, f"{type(exc).__name__}: {exc}")
 
     def _groupable(self, pbs: Sequence) -> bool:
         from ..engine import simulator as sim
@@ -286,7 +286,10 @@ class Supervisor:
             "no rung served and none faulted")
 
     def _solve_group_supervised(self, pbs: Sequence, max_limit: int = 0):
-        """Group ladder: sharded (mesh) → batched → per-item fallback."""
+        """Group ladder: sharded (mesh) → batched → per-item fallback.
+        Returns one (result, error) pair per problem — the per-item fallback
+        contains each problem's faults individually, so one poisoned request
+        cannot error every coalesced class in the drain."""
         from ..parallel import mesh as mesh_lib
         from ..parallel import sweep as sweep_mod
         n = pbs[0].snapshot.num_nodes
@@ -304,7 +307,7 @@ class Supervisor:
                     phase=guard.PHASE_COMPILE, batch=len(pbs),
                     mesh_shape=shape)
                 if not isinstance(fault, RuntimeFault):
-                    return [degrade._stamp(r, RUNG_SHARDED, degraded)
+                    return [(degrade._stamp(r, RUNG_SHARDED, degraded), None)
                             for r in fault]
                 degrade._record(fault, RUNG_BATCHED)
                 degraded = True
@@ -320,12 +323,11 @@ class Supervisor:
                 site=SITE_GROUP, rung=RUNG_BATCHED, nodes=n,
                 phase=guard.PHASE_COMPILE, batch=len(pbs))
             if not isinstance(fault, RuntimeFault):
-                return [degrade._stamp(r, RUNG_BATCHED, degraded)
+                return [(degrade._stamp(r, RUNG_BATCHED, degraded), None)
                         for r in fault]
             degrade._record(fault, RUNG_FUSED)
         self._drop_memos(pbs)
-        return [self._solve_one_supervised(pb, max_limit=max_limit,
-                                           degraded=True)
+        return [self._solve_item(pb, max_limit=max_limit, degraded=True)
                 for pb in pbs]
 
     def _attempt_rung(self, br, fn, *, site: str, rung: str, nodes: int,
@@ -348,7 +350,11 @@ class Supervisor:
             except RuntimeFault as fault:
                 br.record_fault(fault)
                 attempts += 1
-                if attempts > cfg.retries_for(fault.code):
+                if (attempts > cfg.retries_for(fault.code)
+                        or br.state != STATE_CLOSED):
+                    # the fault may have opened the breaker (threshold hit,
+                    # or a failed half-open probe): a retry would run against
+                    # an open breaker, and its success could not close it
                     return fault
                 if cfg.backoff_s > 0:
                     cfg.sleep(min(cfg.backoff_max_s,
@@ -360,45 +366,53 @@ class Supervisor:
                 br.record_abort()
                 raise
 
-    def _probe_stale(self, pbs: Sequence) -> None:
+    def _probe_stale(self, classes: Sequence) -> None:
         """Canary probes for rungs the ladder no longer visits.  A breaker
         below the serving path sees no organic traffic once the rung above
         recovers (the ladder stops at the first success), so its half-open
         probe would starve and the breaker would stay open forever.  After
         each drain, any non-closed breaker whose rung went unvisited gets
-        one probe solve — against this drain's own problems, so the probe
-        re-lands on the executables the organic path already compiled and
-        never traces anything new.  Success closes the breaker; a fault
+        one probe solve — against this drain's own problems AND max_limit
+        (the budget quantizes the chunk length, a static jit arg), so the
+        probe re-lands on the executables the organic path already compiled
+        and never traces anything new.  Success closes the breaker; a fault
         re-opens it (and restarts the cooldown), exactly like an organic
         half-open probe."""
-        if not pbs:
+        if not classes:
             return
         from ..engine import fast_path
         from ..parallel import sweep as sweep_mod
         cfg = self.config
-        pb = pbs[0]
+        req0, pb = classes[0][0]
+        ml = req0.max_limit
         n = pb.snapshot.num_nodes
         probes = {
             RUNG_FUSED: (SITE_SOLVE, guard.PHASE_EXECUTE, None,
-                         lambda: fast_path.solve_auto(pb, bounds=cfg.bounds)),
+                         lambda: fast_path.solve_auto(
+                             pb, max_limit=ml, bounds=cfg.bounds)),
             RUNG_FAST_PATH: (SITE_FAST_PATH, guard.PHASE_EXECUTE, None,
-                             lambda: fast_path.solve_fast(pb)),
+                             lambda: fast_path.solve_fast(pb, max_limit=ml)),
             RUNG_ORACLE: (SITE_ORACLE, guard.PHASE_EXECUTE, None,
-                          lambda: degrade._solve_oracle(pb)),
+                          lambda: degrade._solve_oracle(pb, max_limit=ml)),
         }
-        # group rungs only probe with the full representative set: a probe
-        # with a different batch shape would trace a fresh executable, and
-        # compile cost is a budgeted warmup-only resource
-        if len(pbs) > 1 and self._groupable(pbs):
+        # group rungs only probe with the full representative set at a
+        # single shared budget — the same admission rule _dispatch used to
+        # compile the group executable; a probe with a different batch shape
+        # or budget would trace a fresh executable, and compile cost is a
+        # budgeted warmup-only resource
+        pbs = [cls[0][1] for cls in classes]
+        limits = {cls[0][0].max_limit for cls in classes}
+        if len(pbs) > 1 and len(limits) == 1 and self._groupable(pbs):
             probes[RUNG_BATCHED] = (
                 SITE_GROUP, guard.PHASE_COMPILE, len(pbs),
-                lambda: sweep_mod.solve_group(list(pbs), mesh=None,
-                                              bounds=cfg.bounds))
+                lambda: sweep_mod.solve_group(list(pbs), max_limit=ml,
+                                              mesh=None, bounds=cfg.bounds))
             if self.mesh is not None:
                 probes[RUNG_SHARDED] = (
                     SITE_SHARDED, guard.PHASE_COMPILE, len(pbs),
-                    lambda: sweep_mod.solve_group(list(pbs), mesh=self.mesh,
-                                                  bounds=cfg.bounds))
+                    lambda: sweep_mod.solve_group(
+                        list(pbs), max_limit=ml, mesh=self.mesh,
+                        bounds=cfg.bounds))
         for br in self.board.breakers():
             if br.state == STATE_CLOSED or br.rung in self._visited \
                     or br.rung not in probes:

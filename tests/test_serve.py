@@ -283,6 +283,80 @@ def test_canary_probe_recovers_buried_rung():
     assert sup.board.all_closed()
 
 
+def test_group_fallback_isolates_poisoned_request():
+    """Per-request fault isolation in the per-item fallback: one pb that
+    exhausts its whole ladder must error ONLY its own signature class —
+    its drain-mates keep their answers."""
+    sup = _sup(threshold=100)
+    t1, t2 = _template("a"), _template("b", cpu=900)
+    with faults.suspended():
+        ref = fast_path.solve_auto(sup.store.problems([t2])[0])
+    faults.install([
+        faults.FaultSpec(faults.SITE_GROUP, faults.KIND_CORRUPT,
+                         at=1, times=1),
+        faults.FaultSpec(faults.SITE_SOLVE, faults.KIND_CORRUPT,
+                         at=1, times=1),
+        faults.FaultSpec(faults.SITE_FAST_PATH, faults.KIND_CORRUPT,
+                         at=1, times=1),
+        faults.FaultSpec(faults.SITE_ORACLE, faults.KIND_CORRUPT,
+                         at=1, times=1)])
+    sup.submit(t1)
+    sup.submit(t2)
+    answers = sup.drain()
+    faults.clear()
+    assert len(answers) == 2
+    a1, a2 = answers                         # drain sorts by request id
+    assert a1.error is not None and "NumericCorruption" in a1.error
+    assert a2.error is None and a2.degraded
+    _same(a2.result, ref)
+
+
+def test_retry_stops_when_fault_opens_breaker():
+    """Same-rung retries re-consult the breaker: when the fault that just
+    fired opened it (threshold reached), a retry would run against the OPEN
+    breaker — and its success could not close it — so the ExecuteTimeout
+    retry budget must go unused."""
+    sleeps = []
+    sup = _sup(threshold=1, cooldown=1000.0, backoff_s=0.01,
+               sleep=sleeps.append)
+    with faults.inject("engine.solve:hang:1:0"):
+        ans = sup.serve(_template())
+    assert ans.error is None and ans.degraded
+    assert ans.rung == degrade.RUNG_FAST_PATH
+    assert sup.board.breaker(degrade.RUNG_FUSED).state == STATE_OPEN
+    assert sleeps == []          # no same-rung retry against an open breaker
+
+
+def test_canary_probe_replays_max_limit(monkeypatch):
+    """A canary probe must solve with the drain's max_limit bound: an
+    unbounded probe would quantize a different chunk length (a static jit
+    arg) and trace a fresh executable, breaking the zero-steady-state-
+    recompile invariant the soak pins."""
+    clock = FakeClock()
+    sup = _sup(clock=clock, threshold=1, cooldown=5.0)
+    tpl = _template()
+    faults.install([faults.FaultSpec(faults.SITE_SOLVE, faults.KIND_OOM,
+                                     at=1, times=0),
+                    faults.FaultSpec(faults.SITE_FAST_PATH,
+                                     faults.KIND_CORRUPT, at=1, times=0)])
+    ans = sup.serve(tpl, max_limit=3)
+    assert ans.rung == degrade.RUNG_ORACLE and ans.degraded
+    faults.clear()
+    clock.advance(6.0)
+    seen = []
+    orig = fast_path.solve_fast
+
+    def spy(pb, max_limit=0, **kw):
+        seen.append(max_limit)
+        return orig(pb, max_limit=max_limit, **kw)
+
+    monkeypatch.setattr(fast_path, "solve_fast", spy)
+    ans = sup.serve(tpl, max_limit=3)
+    assert ans.rung == degrade.RUNG_FUSED and not ans.degraded
+    assert sup.board.all_closed()            # canary probe closed fast_path
+    assert seen and all(ml == 3 for ml in seen)
+
+
 def test_unclassified_probe_error_does_not_wedge_breaker():
     """The soak's half-open wedge, end to end: an error-kind injection
     (unclassified) hits the admitted probe; the drain must contain it with
@@ -485,6 +559,35 @@ def test_add_node_grows_axis_with_full_rebuild():
     assert grown.placed_count > base.placed_count
     # duplicate name is a validation failure, not a corrupt axis
     assert store.apply({"op": "add_node", "node": new}) is False
+
+
+def test_add_node_preserves_aux_objects():
+    """The add_node rebuild must carry the snapshot's auxiliary objects
+    (services, pvcs, ... — OBJECT_FIELDS) like _commit_roster does, or the
+    daemon silently sheds storage/topology constraints and its answers
+    diverge from a fresh offline solve of the same world."""
+    nodes = [build_test_node(f"srv-{i}", 2000 + 317 * i,
+                             (4 + i) * 1024 ** 3, 32) for i in range(3)]
+    svc = {"metadata": {"name": "svc-a", "namespace": "default"},
+           "spec": {"selector": {"app": "a"}}}
+    pvc = {"metadata": {"name": "pvc-a", "namespace": "default"},
+           "spec": {"storageClassName": "fast"}}
+    store = SnapshotStore(
+        ClusterSnapshot.from_objects(nodes, [], services=[svc], pvcs=[pvc]),
+        SchedulerProfile())
+    new = build_test_node("srv-9", 4000, 8 * 1024 ** 3, 32)
+    assert store.apply({"op": "add_node", "node": new})
+    assert store.snapshot.services == [svc]
+    assert store.snapshot.pvcs == [pvc]
+    # and the grown world answers bit-identically to a fresh offline build
+    # carrying the same objects (the soak's bit-identity contract)
+    fresh = SnapshotStore(
+        ClusterSnapshot.from_objects(nodes + [new], [], services=[svc],
+                                     pvcs=[pvc]),
+        SchedulerProfile())
+    tpl = _template()
+    _same(fast_path.solve_auto(store.problems([tpl])[0]),
+          fast_path.solve_auto(fresh.problems([tpl])[0]))
 
 
 def test_supervisor_survives_bad_deltas_mid_serving():
