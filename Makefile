@@ -7,7 +7,7 @@ CXX ?= g++
 CXXFLAGS ?= -O2 -std=c++17 -fPIC -Wall
 NATIVE_LIB := cluster_capacity_tpu/models/libccsnap.so
 
-.PHONY: all build native lint test-unit test-parity test-fuzz test-dist test-integration test-e2e bench multichip perfgate compilegate trend chaos profile-smoke soak soak-smoke clean verify-native ci
+.PHONY: all build native lint concgate test-unit test-parity test-fuzz test-dist test-integration test-e2e bench multichip perfgate compilegate trend chaos profile-smoke soak soak-smoke clean verify-native ci
 
 all: build
 
@@ -26,7 +26,16 @@ $(NATIVE_LIB): native/ccsnap.cpp
 lint:
 	$(PY) tools/lint.py
 	$(PY) -m tools.jaxlint
+	$(PY) -m tools.concgate
 	$(PY) -m tools.irgate
+
+# Static concurrency gate (tools/concgate): lock-order graph, guarded-state
+# discipline (tools/concgate/guards.json + cc- annotations), blocking-under-
+# lock, thread-hostile JAX mutations, check-then-act windows — clears the
+# runway for the multi-threaded daemon front-end (ROADMAP item 1).  Emits
+# the CONCGATE.json artifact for tools/trend.
+concgate:
+	$(PY) -m tools.concgate --json-out CONCGATE.json
 
 # Unit + behavioral suite (fake in-memory clusters; no hardware needed).
 test-unit:

@@ -749,6 +749,10 @@ def _ensure_x64(profile):
     if profile.compute_dtype == "float64" and not jax.config.jax_enable_x64:
         # Parity mode promises bit-exact int64 score math; float32 silently
         # breaks it near capacity boundaries.  Enable x64 for the process.
+        # concgate: disable=LK005 -- idempotent one-shot latch: fires only
+        # while x64 is still off, and every threaded entry point (daemon
+        # CLI, test harness) enables x64 at startup before worker threads
+        # exist, so a concurrent mid-trace flip cannot occur
         jax.config.update("jax_enable_x64", True)
 
 

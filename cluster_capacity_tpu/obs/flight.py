@@ -50,6 +50,10 @@ from ..utils import metrics as metrics_mod
 from . import export, names
 from . import spans as spans_mod
 
+# concgate: disable-file=LK004 -- post-mortem dump path: bundle writes,
+# manifest renames, and prune I/O deliberately run under _dump_lock
+# (serialized triage artifacts; never on the solve hot path)
+
 FLIGHT_SCHEMA = "cc-flight/1"
 MANIFEST_NAME = "MANIFEST.json"
 DEFAULT_MAX_BUNDLES = 16
@@ -80,7 +84,7 @@ _CODE_TO_KIND = {
     "NumericCorruption": "corrupt",
 }
 
-_state: Dict[str, Any] = {
+_state: Dict[str, Any] = {  # cc-guarded-by: _dump_lock
     "config": None,          # dict(dir, argv, max_bundles, capture_ir)
     "in_dump": False,
     "seq": 0,
@@ -100,49 +104,56 @@ def install(directory: str, *, argv: Optional[List[str]] = None,
     """Arm the recorder.  ``argv`` is the command line quoted into each
     bundle's repro line (program name first)."""
     os.makedirs(directory, exist_ok=True)
-    _state["config"] = {
-        "dir": directory,
-        "argv": list(argv) if argv else [],
-        "max_bundles": max(1, int(max_bundles)),
-        "capture_ir": bool(capture_ir),
-    }
-    _state["bundles"] = []
-    _state["degradations"] = []
+    with _dump_lock:
+        _state["config"] = {
+            "dir": directory,
+            "argv": list(argv) if argv else [],
+            "max_bundles": max(1, int(max_bundles)),
+            "capture_ir": bool(capture_ir),
+        }
+        _state["bundles"] = []
+        _state["degradations"] = []
 
 
 def installed() -> bool:
-    return _state["config"] is not None
+    with _dump_lock:
+        return _state["config"] is not None
 
 
 def uninstall() -> None:
-    _state["config"] = None
-    _state["bundles"] = []
-    _state["degradations"] = []
+    with _dump_lock:
+        _state["config"] = None
+        _state["bundles"] = []
+        _state["degradations"] = []
 
 
 def bundle_paths() -> List[str]:
     """Bundles dumped by this process, oldest first (pruned ones removed)."""
-    return [p for p in _state["bundles"] if os.path.isdir(p)]
+    with _dump_lock:
+        paths = list(_state["bundles"])
+    return [p for p in paths if os.path.isdir(p)]
 
 
 def on_degradation(fault, next_rung: str) -> None:
     """degrade._record's hook: note a ladder transition for the manifest."""
-    if _state["config"] is None:
-        return
-    ring = _state["degradations"]
-    ring.append(f"{getattr(fault, 'code', type(fault).__name__)}"
-                f"@{getattr(fault, 'site', '') or '?'} -> {next_rung}")
-    del ring[:-64]
+    with _dump_lock:
+        if _state["config"] is None:
+            return
+        ring = _state["degradations"]
+        ring.append(f"{getattr(fault, 'code', type(fault).__name__)}"
+                    f"@{getattr(fault, 'site', '') or '?'} -> {next_rung}")
+        del ring[:-64]
 
 
 def on_breaker(site: str, rung: str, old_state: str, new_state: str) -> None:
     """serve/breaker's hook: note a circuit-breaker transition so the next
     bundle's manifest shows the breaker history alongside ladder moves."""
-    if _state["config"] is None:
-        return
-    ring = _state["degradations"]
-    ring.append(f"breaker {site}/{rung}: {old_state} -> {new_state}")
-    del ring[:-64]
+    with _dump_lock:
+        if _state["config"] is None:
+            return
+        ring = _state["degradations"]
+        ring.append(f"breaker {site}/{rung}: {old_state} -> {new_state}")
+        del ring[:-64]
 
 
 def on_fault(fault) -> Optional[str]:
@@ -150,6 +161,9 @@ def on_fault(fault) -> Optional[str]:
     fault.  Returns the bundle path, or None (not installed / re-entrant /
     dump failed — failures are reported to stderr, never raised).  Safe to
     call from concurrent threads: dumps serialize on a module lock."""
+    # concgate: disable=LK002 -- benign double-checked fast path: a stale
+    # read can only skip or attempt a dump; the decision that matters is
+    # re-validated under _dump_lock two lines down
     if _state["config"] is None or _state["in_dump"]:
         return None
     with _dump_lock:
@@ -217,7 +231,7 @@ def load_bundle(path: str) -> Dict[str, Any]:
 # dump internals
 # ---------------------------------------------------------------------------
 
-def _repro(fault) -> Dict[str, Any]:
+def _repro(fault) -> Dict[str, Any]:  # cc-holds: _dump_lock
     from ..runtime import faults
     site = getattr(fault, "site", "") or ""
     code = getattr(fault, "code", "") or ""
@@ -290,7 +304,7 @@ def _capture_jaxpr(site: str) -> tuple:
     return text, entry_name
 
 
-def _dump(fault) -> str:
+def _dump(fault) -> str:  # cc-holds: _dump_lock
     from ..runtime import faults
     from ..utils.events import default_recorder
 
@@ -300,7 +314,7 @@ def _dump(fault) -> str:
     span_tail = spans_mod.default_collector.spans()[-MAX_BUNDLE_SPANS:]
     span_events = export.trace_events(span_tail)
     metrics_text = metrics_mod.default_registry.render()
-    event_tail = default_recorder.events[-MAX_BUNDLE_EVENTS:]
+    event_tail = default_recorder.tail(MAX_BUNDLE_EVENTS)
     injected = faults.installed_specs()
 
     code = getattr(fault, "code", type(fault).__name__)
@@ -371,7 +385,7 @@ def _dump(fault) -> str:
     return path
 
 
-def _prune(cfg: Dict[str, Any]) -> None:
+def _prune(cfg: Dict[str, Any]) -> None:  # cc-holds: _dump_lock
     """Keep only the newest max_bundles bundle dirs in the flight dir."""
     import shutil
     try:

@@ -38,6 +38,8 @@ ATTRIBUTION_SCHEMA = "cc-attribution/1"
 # Process-wide sampling switch: memory_stats() is cheap but not free, so the
 # per-dispatch watermark sample is opt-in (capture() and bench child mode
 # turn it on; the always-on path pays only this dict lookup).
+# cc-thread-confined: toggled by capture()/bench setup before worker
+# threads start; readers only observe a stable bool slot (GIL-atomic read)
 _sampling = {"memory": False}
 
 

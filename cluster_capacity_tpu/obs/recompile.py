@@ -27,11 +27,11 @@ from . import spans as spans_mod
 
 _EVENT = "/jax/core/compile/backend_compile_duration"
 _lock = threading.Lock()
-_installed = False
+_installed = False  # cc-guarded-by: _lock
 # live CompileTally sinks: jax.monitoring cannot deregister listeners, so
 # scoped measurement (perfgate's PG005 compile budgets, bench phase splits)
 # subscribes/unsubscribes HERE while the process-wide listener stays put
-_tallies: list = []
+_tallies: list = []  # cc-guarded-by: _lock
 
 
 class CompileTally:
@@ -73,7 +73,9 @@ def install_recompile_hook(registry=None) -> bool:
             return
         reg.inc(names.RECOMPILES)
         reg.inc(names.COMPILE_SECONDS, duration)
-        for tally in tuple(_tallies):
+        with _lock:
+            sinks = tuple(_tallies)
+        for tally in sinks:
             tally.count += 1
             tally.seconds += duration
         sp = spans_mod.default_collector.active_sited()
@@ -85,4 +87,5 @@ def install_recompile_hook(registry=None) -> bool:
 
 
 def installed() -> bool:
-    return _installed
+    with _lock:
+        return _installed

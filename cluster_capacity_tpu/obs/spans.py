@@ -59,12 +59,12 @@ class Collector:
     def __init__(self, max_spans: int = MAX_SPANS):
         self.max_spans = max_spans
         self._lock = threading.Lock()
-        self._spans: List[Span] = []
+        self._spans: List[Span] = []  # cc-guarded-by: _lock
         self._local = threading.local()
-        self._open_sited: List[Span] = []
-        self._seen_sites: set = set()
-        self._next_id = 1
-        self.dropped = 0
+        self._open_sited: List[Span] = []  # cc-guarded-by: _lock
+        self._seen_sites: set = set()  # cc-guarded-by: _lock
+        self._next_id = 1  # cc-guarded-by: _lock
+        self.dropped = 0  # cc-guarded-by: _lock
 
     def _stack(self) -> List[Span]:
         st = getattr(self._local, "stack", None)

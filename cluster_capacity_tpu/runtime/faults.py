@@ -122,7 +122,7 @@ class _State:
     env_loaded: bool = False
 
 
-_state = _State()
+_state = _State()  # cc-guarded-by: _lock
 _lock = threading.Lock()
 
 
@@ -191,7 +191,7 @@ def suspended():
             _state.env_loaded = saved_env
 
 
-def _load_env_locked() -> None:
+def _load_env_locked() -> None:  # cc-holds: _lock
     if _state.env_loaded:
         return
     _state.env_loaded = True

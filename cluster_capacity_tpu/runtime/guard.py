@@ -134,7 +134,7 @@ class _Watchdog(threading.Thread):
 
 
 _MAX_IDLE_WATCHDOGS = 4
-_idle_watchdogs: List["_Watchdog"] = []
+_idle_watchdogs: List["_Watchdog"] = []  # cc-guarded-by: _watchdog_lock
 _watchdog_lock = threading.Lock()
 
 

@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Mapping, Optional, Sequence
@@ -109,9 +110,11 @@ class Answer:
 
 
 class Supervisor:
-    """Single-threaded request loop over a SnapshotStore.  Thread safety is
-    by construction: submits queue, drains solve — callers serialize drains
-    (the daemon CLI and the soak harness both drive one loop)."""
+    """Request loop over a SnapshotStore.  Concurrency contract: ``submit``
+    is safe from any thread (the intake queue is lock-protected); callers
+    still serialize ``drain`` — solve state (_visited, answer counters,
+    breaker board, store memos) is confined to the draining thread (the
+    daemon CLI and the soak harness both drive one loop)."""
 
     def __init__(self, store: SnapshotStore,
                  config: Optional[ServeConfig] = None, mesh=None):
@@ -120,8 +123,9 @@ class Supervisor:
         self.mesh = mesh
         self.board = BreakerBoard(self.config.breaker,
                                   clock=self.config.clock)
-        self._pending: List[Request] = []
-        self._visited: set = set()   # rungs attempted in the current drain
+        self._lock = threading.Lock()   # guards the intake queue ONLY
+        self._pending: List[Request] = []  # cc-guarded-by: _lock
+        self._visited: set = set()  # cc-thread-confined: drain thread (rungs attempted in the current drain)
         self._ids = itertools.count(1)
         self.answers = 0
         self.degraded_answers = 0
@@ -134,7 +138,8 @@ class Supervisor:
     def submit(self, template: dict, max_limit: int = 0) -> Request:
         req = Request(id=next(self._ids), template=template,
                       max_limit=max_limit)
-        self._pending.append(req)
+        with self._lock:
+            self._pending.append(req)
         return req
 
     def serve(self, template: dict, max_limit: int = 0) -> Answer:
@@ -152,7 +157,8 @@ class Supervisor:
         state, coalesce, dispatch through the breaker-aware ladder, and
         answer each request.  A failure answers its requests with an error;
         it never escapes this method."""
-        reqs, self._pending = self._pending, []
+        with self._lock:
+            reqs, self._pending = self._pending, []
         if not reqs:
             return []
         t0 = self.config.clock()

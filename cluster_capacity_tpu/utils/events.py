@@ -8,6 +8,7 @@ but actually retains events in memory for inspection and report debugging."""
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import List, Optional
@@ -33,24 +34,36 @@ class Recorder:
     the ring has evicted over its lifetime)."""
 
     max_events: int = 10000
-    events: List[Event] = field(default_factory=list)
-    dropped: int = 0
+    events: List[Event] = field(default_factory=list)  # cc-guarded-by: _lock
+    dropped: int = 0  # cc-guarded-by: _lock
+    _lock: threading.Lock = field(default_factory=threading.Lock,
+                                  repr=False, compare=False)
 
     def eventf(self, object_name: str, reason: str, message: str) -> None:
-        self.events.append(Event(reason=reason, message=message,
-                                 object_name=object_name,
-                                 timestamp=time.time()))
-        overflow = len(self.events) - self.max_events
-        if overflow > 0:
-            del self.events[:overflow]
-            self.dropped += overflow
+        ev = Event(reason=reason, message=message,
+                   object_name=object_name, timestamp=time.time())
+        with self._lock:
+            self.events.append(ev)
+            overflow = len(self.events) - self.max_events
+            if overflow > 0:
+                del self.events[:overflow]
+                self.dropped += overflow
 
     def by_reason(self, reason: str) -> List[Event]:
-        return [e for e in self.events if e.reason == reason]
+        with self._lock:
+            return [e for e in self.events if e.reason == reason]
+
+    def tail(self, n: int) -> List[Event]:
+        """Consistent snapshot of the newest `n` events (the flight
+        recorder bundles this; an unlocked slice can interleave with a
+        trim and duplicate or skip entries)."""
+        with self._lock:
+            return list(self.events[-n:]) if n > 0 else []
 
     def clear(self) -> None:
-        self.events.clear()
-        self.dropped = 0
+        with self._lock:
+            self.events.clear()
+            self.dropped = 0
 
 
 default_recorder = Recorder()

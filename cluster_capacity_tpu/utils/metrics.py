@@ -56,9 +56,10 @@ class Registry:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self.counters: Dict[Tuple[str, LabelKey], float] = defaultdict(float)
-        self.gauges: Dict[Tuple[str, LabelKey], float] = {}
-        self.histograms: Dict[Tuple[str, LabelKey], _Histogram] = {}
+        self.counters: Dict[Tuple[str, LabelKey], float] = \
+            defaultdict(float)  # cc-guarded-by: _lock
+        self.gauges: Dict[Tuple[str, LabelKey], float] = {}  # cc-guarded-by: _lock
+        self.histograms: Dict[Tuple[str, LabelKey], _Histogram] = {}  # cc-guarded-by: _lock
 
     def inc(self, name: str, amount: float = 1.0, **labels) -> None:
         key = (name, _label_key(labels))
@@ -79,10 +80,12 @@ class Registry:
             h.observe(value)
 
     def get(self, name: str, **labels) -> float:
-        return self.counters.get((name, _label_key(labels)), 0.0)
+        with self._lock:
+            return self.counters.get((name, _label_key(labels)), 0.0)
 
     def get_gauge(self, name: str, **labels) -> float:
-        return self.gauges.get((name, _label_key(labels)), 0.0)
+        with self._lock:
+            return self.gauges.get((name, _label_key(labels)), 0.0)
 
     def counter_total(self, name: str) -> float:
         """Sum of a counter across all label sets."""

@@ -1,0 +1,649 @@
+"""concgate rule pins (positive + negative cases per rule), the
+reasoned-suppression mechanics, the seeded-deadlock LK001 regression over
+the REAL runtime lock modules, the dynamic lock witness, and the 8-thread
+serving fuzz: concurrent submits + flight dumps + metric renders must
+produce bit-identical answers to a sequential run with zero witnessed
+lock-order violations and zero unmodeled edges."""
+
+import os
+import threading
+
+import pytest
+
+from tools import concgate
+from tools.concgate import analyze_source, analyze_sources, static_edges
+from tools.concgate.witness import (Witness, WitnessedLock,
+                                    install_defaults, install_supervisor)
+
+REPO = concgate.REPO
+MEM = "cluster_capacity_tpu/runtime/_mem.py"       # threaded prefix
+COLD = "cluster_capacity_tpu/cli/_mem.py"          # not a threaded prefix
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+def only_rule(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+# guards doc for the in-memory fixtures: one guarded module global
+MEM_GUARDS = {"guarded": {"runtime._mem._state": "runtime._mem._lock"}}
+
+
+# ---------------------------------------------------------------------------
+# LK001 lock-order cycles
+# ---------------------------------------------------------------------------
+
+def test_lk001_opposite_order_direct():
+    src = '''"""m."""
+import threading
+_a = threading.Lock()
+_b = threading.Lock()
+
+def ab():
+    with _a:
+        with _b:
+            pass
+
+def ba():
+    with _b:
+        with _a:
+            pass
+'''
+    findings = only_rule(analyze_source(src, only=["lock-order"]), "LK001")
+    assert len(findings) == 1
+    # the message must name BOTH acquisition paths, not just the cycle
+    assert "ab" in findings[0].message and "ba" in findings[0].message
+    assert "_a" in findings[0].message and "_b" in findings[0].message
+
+
+def test_lk001_negative_consistent_order_keeps_edge():
+    src = '''"""m."""
+import threading
+_a = threading.Lock()
+_b = threading.Lock()
+
+def ab():
+    with _a:
+        with _b:
+            pass
+
+def also_ab():
+    with _a:
+        with _b:
+            pass
+'''
+    report = analyze_sources([(MEM, src)], only=["lock-order"])
+    assert report.findings == []
+    assert static_edges(report) == {("runtime._mem._a", "runtime._mem._b")}
+
+
+def test_lk001_interprocedural_cycle():
+    src = '''"""m."""
+import threading
+_a = threading.Lock()
+_b = threading.Lock()
+
+def outer():
+    with _a:
+        inner()
+
+def inner():
+    with _b:
+        pass
+
+def rev():
+    with _b:
+        with _a:
+            pass
+'''
+    findings = only_rule(analyze_source(src, only=["lock-order"]), "LK001")
+    assert len(findings) == 1
+    assert "inner" in findings[0].message or "outer" in findings[0].message
+
+
+def test_lk001_self_deadlock_on_plain_lock():
+    src = '''"""m."""
+import threading
+_a = threading.Lock()
+
+def re_enter():
+    with _a:
+        with _a:
+            pass
+'''
+    findings = only_rule(analyze_source(src, only=["lock-order"]), "LK001")
+    assert len(findings) == 1
+    assert "self-deadlock" in findings[0].message
+
+
+def test_lk001_negative_rlock_reentry():
+    src = '''"""m."""
+import threading
+_a = threading.RLock()
+
+def re_enter():
+    with _a:
+        with _a:
+            pass
+'''
+    assert analyze_source(src, only=["lock-order"]) == []
+
+
+def test_lk001_seeded_deadlock_against_real_runtime_locks():
+    """The acceptance drill: two fixture modules acquire the REAL
+    runtime.faults / runtime.guard module locks in opposite orders; the
+    gate must produce an LK001 naming both acquisition paths."""
+    sources = []
+    for rel in ("cluster_capacity_tpu/runtime/faults.py",
+                "cluster_capacity_tpu/runtime/guard.py"):
+        with open(os.path.join(REPO, rel), encoding="utf-8") as fh:
+            sources.append((rel, fh.read()))
+    sources.append(("cluster_capacity_tpu/runtime/_fx_fwd.py", '''"""m."""
+from cluster_capacity_tpu.runtime import faults, guard
+
+def sweep_forward():
+    with faults._lock:
+        with guard._watchdog_lock:
+            pass
+'''))
+    sources.append(("cluster_capacity_tpu/runtime/_fx_rev.py", '''"""m."""
+from cluster_capacity_tpu.runtime import faults, guard
+
+def sweep_reverse():
+    with guard._watchdog_lock:
+        with faults._lock:
+            pass
+'''))
+    report = analyze_sources(sources, guards_doc=concgate.load_guards(),
+                             only=["lock-order"])
+    lk001 = only_rule(report.findings, "LK001")
+    assert len(lk001) == 1
+    msg = lk001[0].message
+    assert "runtime.faults._lock" in msg
+    assert "runtime.guard._watchdog_lock" in msg
+    # both acquisition paths are named, with file:line provenance
+    assert "_fx_fwd.py" in msg and "_fx_rev.py" in msg
+
+
+# ---------------------------------------------------------------------------
+# LK002 guarded-state discipline
+# ---------------------------------------------------------------------------
+
+def test_lk002_unlocked_write_of_guarded_global():
+    src = '''"""m."""
+import threading
+_lock = threading.Lock()
+_state = {}
+
+def bad():
+    _state["k"] = 1
+'''
+    findings = only_rule(
+        analyze_source(src, guards_doc=MEM_GUARDS), "LK002")
+    assert len(findings) == 1
+    assert "_state" in findings[0].message
+
+
+def test_lk002_negative_write_under_the_declared_lock():
+    src = '''"""m."""
+import threading
+_lock = threading.Lock()
+_state = {}
+
+def good():
+    with _lock:
+        _state["k"] = 1
+'''
+    assert only_rule(
+        analyze_source(src, guards_doc=MEM_GUARDS), "LK002") == []
+
+
+def test_lk002_negative_cc_holds_function_is_exempt():
+    src = '''"""m."""
+import threading
+_lock = threading.Lock()
+_state = {}
+
+def helper_locked():  # cc-holds: _lock
+    _state["k"] = 2
+'''
+    assert only_rule(
+        analyze_source(src, guards_doc=MEM_GUARDS), "LK002") == []
+
+
+def test_lk002_inline_annotation_declares_the_guard():
+    src = '''"""m."""
+import threading
+_lock = threading.Lock()
+_state = {}  # cc-guarded-by: _lock
+
+def bad():
+    _state["k"] = 1
+'''
+    assert "LK002" in rules_of(analyze_source(src))
+
+
+def test_lk002_negative_init_of_declaring_class():
+    src = '''"""m."""
+import threading
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = []  # cc-guarded-by: _lock
+
+    def add(self, x):
+        with self._lock:
+            self.items.append(x)
+'''
+    assert only_rule(analyze_source(src), "LK002") == []
+
+
+# ---------------------------------------------------------------------------
+# LK003 undeclared mutable globals in threaded modules
+# ---------------------------------------------------------------------------
+
+def test_lk003_undeclared_mutable_global():
+    src = '''"""m."""
+_cache = {}
+'''
+    findings = only_rule(analyze_source(src), "LK003")
+    assert len(findings) == 1
+    assert "_cache" in findings[0].message
+
+
+def test_lk003_negative_exemptions():
+    src = '''"""m."""
+import itertools
+import threading
+
+TABLE = {"a": 1}                     # ALL_CAPS: constant by convention
+_lock = threading.Lock()             # locks are the synchronization
+_ids = itertools.count()             # GIL-atomic counter
+_name = "x"                          # immutable value
+_annotated = {}  # cc-guarded-by: _lock
+'''
+    assert only_rule(analyze_source(src), "LK003") == []
+
+
+def test_lk003_negative_outside_threaded_prefixes():
+    assert only_rule(analyze_source('''"""m."""
+_cache = {}
+''', path=COLD), "LK003") == []
+
+
+# ---------------------------------------------------------------------------
+# LK004 blocking under a lock
+# ---------------------------------------------------------------------------
+
+def test_lk004_sleep_under_lock():
+    src = '''"""m."""
+import threading
+import time
+_lock = threading.Lock()
+
+def bad():
+    with _lock:
+        time.sleep(0.1)
+'''
+    findings = only_rule(analyze_source(src), "LK004")
+    assert len(findings) == 1
+    assert "time.sleep" in findings[0].message
+
+
+def test_lk004_negative_sleep_outside_lock():
+    src = '''"""m."""
+import threading
+import time
+_lock = threading.Lock()
+
+def good():
+    time.sleep(0.1)
+    with _lock:
+        pass
+'''
+    assert only_rule(analyze_source(src), "LK004") == []
+
+
+# ---------------------------------------------------------------------------
+# LK005 thread-hostile JAX mutations reachable from thread roots
+# ---------------------------------------------------------------------------
+
+def test_lk005_config_update_reachable_from_watchdog_root():
+    src = '''"""m."""
+import jax
+
+class _Watchdog:
+    def run(self):
+        _poke()
+
+def _poke():
+    jax.config.update("jax_enable_x64", True)
+'''
+    findings = only_rule(analyze_source(
+        src, path="cluster_capacity_tpu/runtime/guard.py"), "LK005")
+    assert len(findings) == 1
+    assert "jax.config.update" in findings[0].message
+    assert "_poke" in findings[0].message     # the call chain is named
+
+
+def test_lk005_negative_unreachable_from_roots():
+    src = '''"""m."""
+import jax
+
+def main_thread_setup():
+    jax.config.update("jax_enable_x64", True)
+'''
+    assert only_rule(analyze_source(
+        src, path="cluster_capacity_tpu/runtime/guard.py"), "LK005") == []
+
+
+# ---------------------------------------------------------------------------
+# LK006 check-then-act windows
+# ---------------------------------------------------------------------------
+
+def test_lk006_unlocked_check_then_act():
+    src = '''"""m."""
+import threading
+_lock = threading.Lock()
+_state = {"installed": False}
+
+def toggle():
+    if not _state["installed"]:
+        _state["installed"] = True
+'''
+    assert "LK006" in rules_of(analyze_source(src, guards_doc=MEM_GUARDS))
+
+
+def test_lk006_negative_lock_spans_check_and_act():
+    src = '''"""m."""
+import threading
+_lock = threading.Lock()
+_state = {"installed": False}
+
+def toggle():
+    with _lock:
+        if not _state["installed"]:
+            _state["installed"] = True
+'''
+    assert only_rule(
+        analyze_source(src, guards_doc=MEM_GUARDS), "LK006") == []
+
+
+# ---------------------------------------------------------------------------
+# suppression mechanics: a reason is mandatory
+# ---------------------------------------------------------------------------
+
+def test_suppression_with_reason_is_honored_and_tallied():
+    src = '''"""m."""
+# concgate: disable=LK003 -- populated once at import, frozen afterwards
+_cache = {}
+'''
+    report = analyze_sources([(MEM, src)])
+    assert report.findings == []
+    assert [f.rule for f in report.suppressed] == ["LK003"]
+    assert report.dead == []
+
+
+def test_reasonless_suppression_is_itself_a_finding():
+    src = '''"""m."""
+# concgate: disable=LK003
+_cache = {}
+'''
+    report = analyze_sources([(MEM, src)])
+    assert rules_of(report.findings) == {"LK000"}
+    assert "no `-- reason`" in report.findings[0].message
+    # the LK003 is still eaten — but the gate fails anyway, on the LK000
+    assert [f.rule for f in report.suppressed] == ["LK003"]
+
+
+def test_dead_suppression_is_reported():
+    src = '''"""m."""
+# concgate: disable=LK004 -- stale comment, nothing blocks here
+_NOTHING = 1
+'''
+    report = analyze_sources([(MEM, src)])
+    assert report.findings == []
+    assert report.dead == [(MEM, 3, "LK004")]
+
+
+def test_guards_doc_unknown_lock_is_lk000():
+    src = '''"""m."""
+_state = {}
+'''
+    doc = {"guarded": {"runtime._mem._state": "runtime._mem._nope"}}
+    findings = analyze_source(src, guards_doc=doc, only=["registry"])
+    assert rules_of(findings) == {"LK000"}
+    assert "_nope" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# the real tree: gate clean, lock graph acyclic
+# ---------------------------------------------------------------------------
+
+def _tree_files():
+    rels = []
+    for dirpath, _dirs, files in os.walk(
+            os.path.join(REPO, "cluster_capacity_tpu")):
+        for fn in sorted(files):
+            if fn.endswith(".py"):
+                rels.append(os.path.relpath(
+                    os.path.join(dirpath, fn), REPO).replace(os.sep, "/"))
+    return sorted(rels)
+
+
+def test_real_tree_is_clean_with_reasoned_suppressions_only():
+    report = concgate.analyze_files(REPO, _tree_files(),
+                                    guards_doc=concgate.load_guards())
+    assert report.findings == []
+    assert report.dead == []
+    # the tolerated findings are inline suppressions, every one reasoned
+    assert report.suppressed, "expected the documented suppressions"
+
+
+def test_real_tree_lock_graph_is_acyclic():
+    report = concgate.analyze_files(REPO, _tree_files(),
+                                    guards_doc=concgate.load_guards())
+    static = static_edges(report)
+    # the flight dump lock is the only outer lock in the tree today
+    assert static, "expected the flight-dump lock-order edges"
+    assert all(src == "obs.flight._dump_lock" for src, _ in static)
+    # an empty witness checks cycles over the static graph alone
+    assert Witness().violations(static) == []
+
+
+# ---------------------------------------------------------------------------
+# dynamic witness unit behavior
+# ---------------------------------------------------------------------------
+
+def _on_thread(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    t.join()
+
+
+def test_witness_detects_opposite_order_across_threads():
+    w = Witness()
+
+    def t1():
+        w.note_acquire("A")
+        w.note_acquire("B")
+        w.note_release("B")
+        w.note_release("A")
+
+    def t2():
+        w.note_acquire("B")
+        w.note_acquire("A")
+        w.note_release("A")
+        w.note_release("B")
+
+    _on_thread(t1)
+    _on_thread(t2)
+    assert w.edges() == {("A", "B"), ("B", "A")}
+    assert any("A -> B -> A" in v or "B -> A -> B" in v
+               for v in w.violations(set()))
+
+
+def test_witness_rlock_reentry_records_no_edge():
+    w = Witness()
+    w.note_acquire("A")
+    w.note_acquire("A")          # re-entry: not an ordering event
+    w.note_acquire("B")
+    assert w.edges() == {("A", "B")}
+    w.note_release("B")
+    w.note_release("A")
+    w.note_release("A")
+
+
+def test_witness_unmodeled_vs_static():
+    w = Witness()
+    w.note_acquire("A")
+    w.note_acquire("B")
+    w.note_release("B")
+    w.note_release("A")
+    assert w.unmodeled({("A", "B")}) == []
+    assert len(w.unmodeled(set())) == 1
+    assert w.violations({("A", "B")}) == []   # consistent union
+
+
+def test_witnessed_lock_failed_acquire_rolls_back():
+    w = Witness()
+    inner = threading.Lock()
+    proxy = WitnessedLock("A", inner, w)
+    other = threading.Lock()
+    _on_thread(inner.acquire)                 # held elsewhere, forever
+    assert proxy.acquire(blocking=False) is False
+    # the failed acquire must not leave "A" on the held stack
+    with WitnessedLock("B", other, w):
+        pass
+    assert w.edges() == set()
+
+
+def test_witnessed_lock_proxies_context_manager_and_edges():
+    w = Witness()
+    a = WitnessedLock("A", threading.Lock(), w)
+    b = WitnessedLock("B", threading.Lock(), w)
+    with a:
+        assert a.locked()                     # passthrough attribute
+        with b:
+            pass
+    assert w.edges() == {("A", "B")}
+
+
+# ---------------------------------------------------------------------------
+# 8-thread serving fuzz: witnessed, bit-identical to sequential
+# ---------------------------------------------------------------------------
+
+N_THREADS = 8
+ROUNDS = 6
+
+
+@pytest.fixture
+def _clean_faults():
+    from cluster_capacity_tpu.runtime import faults
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def test_eight_thread_fuzz_is_witnessed_and_bit_identical(
+        tmp_path, _clean_faults):
+    """8 threads hammer Supervisor.submit, direct flight dumps, metric
+    renders, and event writes concurrently; the drained answers must be
+    bit-identical to a sequential run, with zero witnessed lock-order
+    violations and zero edges outside the static LK001 graph."""
+    import numpy as np
+
+    from cluster_capacity_tpu import SchedulerProfile
+    from cluster_capacity_tpu.models.snapshot import ClusterSnapshot
+    from cluster_capacity_tpu.models.podspec import default_pod
+    from cluster_capacity_tpu.obs import flight
+    from cluster_capacity_tpu.runtime.errors import DeviceOOM
+    from cluster_capacity_tpu.serve import (ServeConfig, SnapshotStore,
+                                            Supervisor)
+    from cluster_capacity_tpu.utils.events import default_recorder
+    from cluster_capacity_tpu.utils.metrics import default_registry
+
+    from helpers import build_test_node, build_test_pod
+
+    def store():
+        # heterogeneous allocatable: no ties, so answers are bit-exact
+        nodes = [build_test_node(f"fz-{i}", 2000 + 317 * i,
+                                 (4 + i) * 1024 ** 3, 32)
+                 for i in range(5)]
+        return SnapshotStore(ClusterSnapshot.from_objects(nodes, []),
+                             SchedulerProfile())
+
+    templates = [default_pod(build_test_pod(f"t{i}", 400 + 100 * i, 10 ** 9))
+                 for i in range(N_THREADS)]
+
+    # -- sequential reference ------------------------------------------
+    seq = Supervisor(store(), ServeConfig())
+    want = {}
+    for tpl in templates:
+        for _ in range(ROUNDS):
+            seq.submit(tpl)
+    for ans in seq.drain():
+        assert ans.error is None
+        want[ans.request.template["metadata"]["name"]] = ans.result
+
+    # -- witnessed concurrent run --------------------------------------
+    sup = Supervisor(store(), ServeConfig())
+    witness = Witness()
+    uninstalls = [install_defaults(witness), install_supervisor(sup, witness)]
+    flight.install(str(tmp_path), argv=["test"], max_bundles=4,
+                   capture_ir=False)
+    barrier = threading.Barrier(N_THREADS)
+    errs = []
+
+    def worker(k):
+        try:
+            barrier.wait()
+            for r in range(ROUNDS):
+                sup.submit(templates[(k + r) % N_THREADS])
+                if r % 2 == 0:
+                    flight.on_fault(DeviceOOM(f"fz {k}.{r}",
+                                              site="engine.solve"))
+                else:
+                    default_registry.render()
+                    default_recorder.eventf("fuzz", "Tick", f"{k}.{r}")
+        except Exception as exc:  # pragma: no cover - surfaced below
+            errs.append(exc)
+
+    threads = [threading.Thread(target=worker, args=(k,))
+               for k in range(N_THREADS)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        answers = sup.drain()                 # drains are caller-serialized
+    finally:
+        for undo in reversed(uninstalls):
+            undo()
+        flight.uninstall()
+
+    # every submit got exactly one answer, bit-identical to sequential
+    assert len(answers) == N_THREADS * ROUNDS
+    for ans in answers:
+        assert ans.error is None
+        ref = want[ans.request.template["metadata"]["name"]]
+        assert ans.result.placed_count == ref.placed_count
+        assert np.array_equal(np.asarray(ans.result.placements),
+                              np.asarray(ref.placements))
+
+    # the witness verdict: no cycles, nothing outside the static graph
+    report = concgate.analyze_files(REPO, _tree_files(),
+                                    guards_doc=concgate.load_guards())
+    static = static_edges(report)
+    assert witness.violations(static) == []
+    assert witness.unmodeled(static) == []
+    assert witness.edges() <= static
+
+    # the rendered registry stayed internally consistent under the hammer
+    rendered = default_registry.render()
+    assert isinstance(rendered, str)

@@ -1,0 +1,152 @@
+"""concgate: static concurrency gate for the capacity library.
+
+Multi-pass AST analysis over `cluster_capacity_tpu/` (see common.RULES):
+lock-order cycles (LK001), guarded-state discipline (LK002/LK003),
+blocking-under-lock (LK004), thread-hostile JAX mutations (LK005), and
+check-then-act windows (LK006) — plus LK000 for gate misconfiguration,
+including suppressions that carry no reason.
+
+Run via ``make concgate`` or ``python -m tools.concgate``; tests drive
+in-memory modules through :func:`analyze_source` / :func:`analyze_sources`.
+The companion dynamic witness lives in witness.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, NamedTuple, Optional, Sequence, Set, Tuple
+
+from . import baseline, blocking, guarded, hostile, lockorder, witness
+from .common import (PASSES, RULES, Finding, apply_suppressions_ex)
+from .config import GUARDS_PATH, TARGET_DIRS
+from .context import ModuleInfo, Program, module_key
+from .lockorder import Edge
+
+__all__ = ["Finding", "GateReport", "RULES", "PASSES", "TARGET_DIRS",
+           "analyze_source", "analyze_sources", "analyze_files",
+           "build_program", "load_guards", "baseline", "witness",
+           "static_edges", "module_key"]
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+class GateReport(NamedTuple):
+    """Surviving findings (LK000 configuration errors included), what
+    inline suppressions ate, dead suppressions as (path, line, rule) with
+    line 0 for disable-file scope, and the LK001 lock graph (consumed by
+    the dynamic witness and the CONCGATE.json artifact)."""
+
+    findings: List[Finding]
+    suppressed: List[Finding]
+    dead: List[Tuple[str, int, str]]
+    edges: List[Edge]
+
+    def by_rule(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for f in self.findings:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return out
+
+
+def load_guards(path: Optional[str] = None) -> dict:
+    path = path or os.path.join(REPO, GUARDS_PATH)
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def build_program(sources: Sequence[tuple],
+                  guards_doc: Optional[dict] = None) -> Program:
+    """sources: iterable of (repo-relative path, source text)."""
+    mods = [ModuleInfo(module_key(p), p, src) for p, src in sources]
+    return Program(mods, guards_doc=guards_doc)
+
+
+def run_passes_ex(prog: Program,
+                  only: Optional[Sequence[str]] = None) -> GateReport:
+    findings: List[Finding] = []
+    edges: List[Edge] = []
+    if not only or "registry" in only:
+        findings.extend(prog.guards.findings)
+    if not only or "lock-order" in only:
+        lk001, edges = lockorder.check(prog)
+        findings.extend(lk001)
+    if not only or "guarded-state" in only:
+        findings.extend(guarded.check(prog))
+    if not only or "blocking-under-lock" in only:
+        findings.extend(blocking.check(prog))
+    if not only or "thread-hostile" in only:
+        findings.extend(hostile.check(prog))
+    kept, suppressed, dead = _suppress(findings, prog)
+    order = lambda f: (f.path, f.line, f.rule, f.message)
+    return GateReport(findings=sorted(set(kept), key=order),
+                      suppressed=sorted(set(suppressed), key=order),
+                      dead=sorted(dead), edges=edges)
+
+
+def _suppress(findings: List[Finding], prog: Program):
+    """Every module is scanned so a suppression in a clean file shows up
+    as dead.  A suppression without a reason does not just warn — it IS a
+    finding (LK000), and one that cannot itself be suppressed."""
+    kept: List[Finding] = []
+    suppressed: List[Finding] = []
+    dead: List[tuple] = []
+    by_path: Dict[str, List[Finding]] = {}
+    for f in findings:
+        by_path.setdefault(f.path, []).append(f)
+    # findings anchored outside the scanned modules (guards.json config
+    # errors) have no source to carry a suppression — they survive as-is
+    module_paths = {m.path for m in prog.modules}
+    kept.extend(f for f in findings if f.path not in module_paths)
+    for m in prog.modules:
+        rep = apply_suppressions_ex(by_path.get(m.path, []), m.source)
+        kept.extend(rep.kept)
+        suppressed.extend(rep.suppressed)
+        dead.extend((m.path, line, rule) for line, rule in rep.dead)
+        for line, rule in rep.unexplained:
+            kept.append(Finding(
+                path=m.path, line=line or 1, rule="LK000",
+                message=f"suppression of {rule} carries no `-- reason`; "
+                        "a concurrency finding is either a bug or a "
+                        "documented decision"))
+    return kept, suppressed, dead
+
+
+def analyze_sources(sources: Sequence[tuple],
+                    guards_doc: Optional[dict] = None,
+                    only: Optional[Sequence[str]] = None) -> GateReport:
+    """Analyze in-memory modules (test entry point).  ``guards_doc``
+    defaults to EMPTY — pass ``load_guards()`` to merge the repo
+    registry."""
+    return run_passes_ex(build_program(sources, guards_doc=guards_doc),
+                         only=only)
+
+
+def analyze_source(source: str,
+                   path: str = "cluster_capacity_tpu/runtime/_mem.py",
+                   guards_doc: Optional[dict] = None,
+                   only: Optional[Sequence[str]] = None) -> List[Finding]:
+    """One in-memory module.  The default synthetic path lands inside a
+    threaded prefix so LK003 is exercised; point it elsewhere to opt
+    out."""
+    return analyze_sources([(path, source)], guards_doc=guards_doc,
+                           only=only).findings
+
+
+def analyze_files(repo_root: str, relpaths: Sequence[str],
+                  guards_doc: Optional[dict] = None,
+                  only: Optional[Sequence[str]] = None) -> GateReport:
+    sources = []
+    for rp in relpaths:
+        with open(os.path.join(repo_root, rp), encoding="utf-8") as f:
+            sources.append((rp.replace(os.sep, "/"), f.read()))
+    return run_passes_ex(build_program(sources, guards_doc=guards_doc),
+                         only=only)
+
+
+def static_edges(report: GateReport) -> Set[Tuple[str, str]]:
+    """The LK001 edge set in the witness's (src, dst) shape."""
+    return {(e.src, e.dst) for e in report.edges}

@@ -1,0 +1,144 @@
+"""concgate CLI: run the concurrency passes and gate on the (empty)
+baseline.
+
+Usage::
+
+    python -m tools.concgate                     # gate cluster_capacity_tpu/
+    python -m tools.concgate path/dir ...        # gate specific roots
+    python -m tools.concgate --json-out CONCGATE.json
+    python -m tools.concgate --write-baseline --reason "why"
+    python -m tools.concgate --list-rules
+
+Exit 0: no findings beyond the baseline and every suppression/baseline
+entry carries a reason.  Exit 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+if __package__ in (None, ""):          # `python tools/concgate/__main__.py`
+    sys.path.insert(0, os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+    from tools.concgate import __main__ as _m   # re-enter as a package
+    sys.exit(_m.main())
+
+from . import REPO, analyze_files, load_guards
+from . import baseline as bl
+from .common import PASSES, RULES
+from .config import BASELINE_PATH, TARGET_DIRS
+
+
+def _discover(roots) -> list:
+    rels = []
+    for root in roots:
+        ab = os.path.join(REPO, root)
+        if os.path.isfile(ab):
+            rels.append(os.path.relpath(ab, REPO))
+            continue
+        for dirpath, _dirnames, filenames in os.walk(ab):
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    rels.append(os.path.relpath(
+                        os.path.join(dirpath, fn), REPO))
+    return sorted(r.replace(os.sep, "/") for r in rels)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="concgate", description="static concurrency gate")
+    ap.add_argument("roots", nargs="*", default=None,
+                    help=f"files/dirs to gate (default: {TARGET_DIRS})")
+    ap.add_argument("--pass", dest="passes", action="append",
+                    choices=PASSES, help="run only this pass (repeatable)")
+    ap.add_argument("--baseline", default=os.path.join(REPO, BASELINE_PATH))
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignore the baseline")
+    ap.add_argument("--write-baseline", action="store_true")
+    ap.add_argument("--reason", default="",
+                    help="reason recorded on --write-baseline entries "
+                         "(required when writing a non-empty baseline)")
+    ap.add_argument("--json-out", default=None,
+                    help="write the CONCGATE.json artifact here")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule, (pname, desc) in sorted(RULES.items()):
+            print(f"{rule}  [{pname}] {desc}")
+        return 0
+
+    t0 = time.time()
+    rels = _discover(args.roots or list(TARGET_DIRS))
+    report = analyze_files(REPO, rels, guards_doc=load_guards(),
+                           only=args.passes)
+    findings = report.findings
+
+    if args.write_baseline:
+        if findings and not args.reason.strip():
+            print("concgate: refusing to write a non-empty baseline "
+                  "without --reason", file=sys.stderr)
+            return 1
+        bl.save(args.baseline, findings, args.reason.strip())
+        print(f"concgate: wrote {len(findings)} finding(s) to "
+              f"{os.path.relpath(args.baseline, REPO)}")
+        return 0
+
+    entries, bl_errors = ({}, []) if args.no_baseline \
+        else bl.load(args.baseline)
+    new, old, stale = bl.split(findings, entries)
+
+    for f in new:
+        print(f.render())
+    for err in bl_errors:
+        print(f"concgate: error: {err}", file=sys.stderr)
+    for key in stale:
+        print(f"concgate: warning: stale baseline entry {key[0]}: "
+              f"{key[1]} (fixed? prune it)", file=sys.stderr)
+    if report.suppressed:
+        by_rule: dict = {}
+        for f in report.suppressed:
+            by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+        tally = ", ".join(f"{r}: {n}" for r, n in sorted(by_rule.items()))
+        print(f"concgate: suppressed: {len(report.suppressed)} finding(s) "
+              f"by rule ({tally})")
+    for path, line, rule in report.dead:
+        where = f"{path}:{line}" if line else f"{path} (file-wide)"
+        print(f"concgate: warning: dead suppression {where}: {rule} "
+              f"suppresses nothing — prune it", file=sys.stderr)
+
+    rc = 1 if (new or bl_errors) else 0
+
+    if args.json_out:
+        doc = {
+            "clean": rc == 0,
+            "findings": len(new),
+            "baselined": len(old),
+            "suppressed": len(report.suppressed),
+            "by_rule": {r: n for r, n in sorted(
+                report.by_rule().items())},
+            "files": len(rels),
+            "lock_graph": sorted({(e.src, e.dst) for e in report.edges}),
+            "rules": {r: RULES[r][1] for r in sorted(RULES)},
+        }
+        out_path = args.json_out if os.path.isabs(args.json_out) \
+            else os.path.join(REPO, args.json_out)
+        with open(out_path, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+
+    dt = time.time() - t0
+    print(f"concgate: {len(rels)} files, {len(findings)} finding(s) "
+          f"({len(new)} new, {len(old)} baselined, "
+          f"{len(report.suppressed)} suppressed), "
+          f"{len({(e.src, e.dst) for e in report.edges})} lock-order "
+          f"edge(s) in {dt:.1f}s")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,64 @@
+"""Baseline load/save/split for concgate.
+
+Same contract as tools/jaxlint/baseline.py — entries are keyed by
+(path, rule, message) so line drift doesn't churn them — with one
+addition: every baseline entry must carry a non-empty ``reason``.  The
+tree SHIPS an empty baseline; the file exists so a future emergency has
+an escape hatch that still forces the author to write down why.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Tuple
+
+from .common import Finding
+
+Key = Tuple[str, str, str]
+
+
+def load(path: str) -> Tuple[Dict[Key, str], List[str]]:
+    """Returns (key -> reason, errors).  A reasonless entry is an error —
+    the gate reports it as LK000 and does not honor the entry."""
+    if not os.path.exists(path):
+        return {}, []
+    with open(path, "r", encoding="utf-8") as fh:
+        doc = json.load(fh)
+    out: Dict[Key, str] = {}
+    errors: List[str] = []
+    for entry in doc.get("findings", []):
+        key = (entry["path"], entry["rule"], entry["message"])
+        reason = (entry.get("reason") or "").strip()
+        if not reason:
+            errors.append(f"{entry['path']}: baseline entry for "
+                          f"{entry['rule']} has no reason")
+            continue
+        out[key] = reason
+    return out, errors
+
+
+def save(path: str, findings: List[Finding], reason: str) -> None:
+    doc = {
+        "comment": "concgate baseline - every entry must carry a reason; "
+                   "prefer inline `# concgate: disable=... -- reason` "
+                   "suppressions next to the code they excuse",
+        "findings": [
+            {"path": f.path, "rule": f.rule, "message": f.message,
+             "reason": reason}
+            for f in sorted(findings, key=lambda f: f.key())
+        ],
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+def split(findings: List[Finding], baseline: Dict[Key, str]
+          ) -> Tuple[List[Finding], List[Finding], List[Key]]:
+    """(new, baselined, stale-baseline-keys)."""
+    keys = {f.key() for f in findings}
+    new = [f for f in findings if f.key() not in baseline]
+    old = [f for f in findings if f.key() in baseline]
+    stale = sorted(k for k in baseline if k not in keys)
+    return new, old, stale

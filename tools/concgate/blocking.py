@@ -1,0 +1,70 @@
+"""LK004: blocking operations under a held lock.
+
+Any call made while the lexical held set is non-empty is matched against
+the blocking vocabulary:
+
+- exact dotted names (time.sleep, os.replace, subprocess.run, ...);
+- bare builtins (open, input);
+- ``runtime.guard.run`` — the guarded-dispatch choke point: a device
+  solve under a lock serializes every other thread behind the device;
+- any resolved ``jax.*`` call (dispatch or trace work, unbounded);
+- irgate's DISPATCH_SET (tools/irgate/guard_audit.py), so the two gates
+  share one definition of "launches device work".
+
+Holding a lock across any of these turns an intended microsecond
+critical section into a milliseconds-to-seconds convoy, and — combined
+with the watchdog's own locks — is how deadlocks hide behind timeouts.
+"""
+
+from __future__ import annotations
+
+from typing import List, Set
+
+from .common import Finding
+from .config import (BLOCKING_BUILTINS, BLOCKING_CALLS, BLOCKING_PREFIXES,
+                     BLOCKING_SUFFIXES)
+from .context import Program, suffix_of
+
+try:        # share the device-dispatch vocabulary with irgate
+    from tools.irgate.guard_audit import DISPATCH_SET as _IRGATE_DISPATCH
+except Exception:       # pragma: no cover - irgate layout changed
+    _IRGATE_DISPATCH = frozenset()
+
+_DISPATCH_SUFFIXES: Set[str] = {
+    f"{mod}.{func}" for mod, func in _IRGATE_DISPATCH}
+
+
+def _blocking_reason(target: str) -> str:
+    if target in BLOCKING_CALLS:
+        return f"blocking call {target}"
+    sfx = suffix_of(target)
+    if sfx in _DISPATCH_SUFFIXES:
+        return f"device dispatch {sfx}"
+    for suffix in BLOCKING_SUFFIXES:
+        if sfx == suffix or sfx.endswith("." + suffix):
+            return f"guarded dispatch {sfx}"
+    for prefix in BLOCKING_PREFIXES:
+        if target.startswith(prefix):
+            return f"jax call {target}"
+    return ""
+
+
+def check(prog: Program) -> List[Finding]:
+    findings: List[Finding] = []
+    for m in prog.modules:
+        for fs in m.funcs.values():
+            for target, _attr, line, held in fs.calls:
+                if not held or target is None:
+                    continue
+                if target in BLOCKING_BUILTINS:
+                    reason = f"blocking builtin {target}()"
+                else:
+                    reason = _blocking_reason(target)
+                if not reason:
+                    continue
+                locks = ", ".join(held)
+                findings.append(Finding(
+                    path=m.path, line=line, rule="LK004",
+                    message=f"{reason} while holding {locks} (in "
+                            f"{m.suffix}.{fs.qualname})"))
+    return findings

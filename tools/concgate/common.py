@@ -1,0 +1,155 @@
+"""Shared concgate data model: findings, rule registry, suppressions.
+
+Mirrors tools/jaxlint/common.py, with one deliberate difference: every
+inline suppression MUST carry a reason.  A concurrency finding is either a
+bug (fix it) or a documented design decision (suppress it and say why) —
+there is no third state where a race quietly rides a bare comment.
+
+Inline suppressions::
+
+  # concgate: disable=LK004 -- dump serialization is the design
+  # concgate: disable=LK002,LK006 -- benign double-checked fast path
+  # concgate: disable-file=LK004 -- post-mortem path, never hot
+
+A suppression without ``-- reason`` text is itself a gate failure (LK000).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Dict, List, NamedTuple, Tuple
+
+_DISABLE_RE = re.compile(
+    r"#\s*concgate:\s*disable(-file)?(?:=([\w, ]+))?(?:\s*--\s*(.*\S))?")
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str          # repo-relative, forward slashes
+    line: int          # 1-based
+    rule: str          # e.g. "LK001"
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+    def key(self) -> Tuple[str, str, str]:
+        """Line-number-independent identity used by the baseline."""
+        return (self.path, self.rule, self.message)
+
+
+# rule id -> (pass name, one-line description).  The doc table in
+# doc/architecture.md mirrors this registry.
+RULES: Dict[str, Tuple[str, str]] = {
+    "LK000": ("registry",
+              "concgate configuration error: unknown lock in a cc- "
+              "annotation / guards.json entry, conflicting guard "
+              "declarations, or a suppression without a reason"),
+    "LK001": ("lock-order",
+              "cycle in the global lock-acquisition graph: two code paths "
+              "acquire the same locks in opposite orders (deadlock)"),
+    "LK002": ("guarded-state",
+              "read/write of a declared-guarded name outside a `with "
+              "<lock>` scope (and outside a `# cc-holds:` function)"),
+    "LK003": ("guarded-state",
+              "undeclared module-level mutable global in a threaded "
+              "module; declare `# cc-guarded-by:` or `# cc-thread-"
+              "confined:`"),
+    "LK004": ("blocking-under-lock",
+              "blocking operation (device dispatch, guard.run, jit call, "
+              "file I/O, sleep, subprocess) while holding a lock"),
+    "LK005": ("thread-hostile",
+              "process-global JAX mutation (jax.config update, "
+              "clear_caches, x64 toggle, factory-cache clear) reachable "
+              "from non-main-thread code"),
+    "LK006": ("check-then-act",
+              "read of a guarded name feeds a branch whose body mutates "
+              "it, without the lock spanning both (lost-update window)"),
+}
+
+PASSES = ("registry", "lock-order", "guarded-state", "blocking-under-lock",
+          "thread-hostile", "check-then-act")
+
+
+class Suppression(NamedTuple):
+    line: int           # 0 for disable-file scope
+    rules: frozenset    # rule ids, or frozenset({"*"})
+    reason: str         # "" when the author forgot one (that is LK000)
+
+
+def parse_suppressions(source: str) -> List[Suppression]:
+    lines = source.splitlines()
+    out: List[Suppression] = []
+    for i, line in enumerate(lines, 1):
+        m = _DISABLE_RE.search(line)
+        if not m:
+            continue
+        rules = (frozenset({"*"}) if not m.group(2) else
+                 frozenset(r.strip().upper() for r in m.group(2).split(",")
+                           if r.strip()))
+        at = i
+        if not m.group(1) and line.strip().startswith("#"):
+            # standalone comment line: the suppression anchors to the next
+            # code line (comment blocks may continue across several lines)
+            for j in range(i, len(lines)):
+                nxt = lines[j].strip()
+                if nxt and not nxt.startswith("#"):
+                    at = j + 1
+                    break
+        out.append(Suppression(line=0 if m.group(1) else at, rules=rules,
+                               reason=(m.group(3) or "").strip()))
+    return out
+
+
+class SuppressionReport(NamedTuple):
+    """What survived, what a comment ate, which comments matched nothing
+    (dead — prune them), and reasonless suppressions (LK000 material).
+    ``dead``/``unexplained`` entries are (line, rule) with line 0 for
+    disable-file scope."""
+
+    kept: List[Finding]
+    suppressed: List[Finding]
+    dead: List[Tuple[int, str]]
+    unexplained: List[Tuple[int, str]]
+
+
+def apply_suppressions_ex(findings: List[Finding],
+                          source: str) -> SuppressionReport:
+    sups = parse_suppressions(source)
+    per_file: Dict[str, str] = {}
+    per_line: Dict[int, Dict[str, str]] = {}
+    unexplained: List[Tuple[int, str]] = []
+    for sup in sups:
+        for rule in sorted(sup.rules):
+            if not sup.reason:
+                unexplained.append((sup.line, rule))
+            if sup.line == 0:
+                per_file.setdefault(rule, sup.reason)
+            else:
+                per_line.setdefault(sup.line, {}).setdefault(rule,
+                                                             sup.reason)
+    kept: List[Finding] = []
+    suppressed: List[Finding] = []
+    used: set = set()
+    for f in findings:
+        if "*" in per_file or f.rule in per_file:
+            used.add((0, "*" if "*" in per_file else f.rule))
+            suppressed.append(f)
+            continue
+        sup = per_line.get(f.line, {})
+        if "*" in sup or f.rule in sup:
+            used.add((f.line, "*" if "*" in sup else f.rule))
+            suppressed.append(f)
+            continue
+        kept.append(f)
+    dead: List[Tuple[int, str]] = []
+    for rule in sorted(per_file):
+        if (0, rule) not in used:
+            dead.append((0, rule))
+    for line in sorted(per_line):
+        for rule in sorted(per_line[line]):
+            if (line, rule) not in used:
+                dead.append((line, rule))
+    return SuppressionReport(kept=kept, suppressed=suppressed, dead=dead,
+                             unexplained=unexplained)

@@ -1,0 +1,93 @@
+"""concgate configuration: scan roots, threaded modules, thread roots, and
+the blocking / thread-hostile call vocabularies.
+
+``THREADED_PREFIXES`` is where LK003 polices undeclared module-level
+mutable globals: the modules whose code already runs (or is about to run,
+per ROADMAP item 1's daemon front-end) on more than one thread — the
+guard's watchdog pool, the obs/ telemetry taps it drives, the metrics and
+event sinks those taps write, and the whole serve/ daemon layer.  Engine
+and parallel modules stay out: their entry points are only reached through
+``guard.run`` on the dispatching thread, and their module state is jit
+caches the compile-budget gate already polices.
+
+``THREAD_ROOTS`` seeds the LK005 call-graph walk: functions whose bodies
+execute on a non-main thread (the watchdog worker loop) or inside the
+daemon's retry/restart paths that a threaded front-end will drive
+concurrently.  Anything transitively reachable from a root must not flip
+process-global JAX state.
+"""
+
+from __future__ import annotations
+
+# Default scan root, relative to the repo root.
+TARGET_DIRS = ("cluster_capacity_tpu",)
+
+PKG = "cluster_capacity_tpu"
+
+# Repo-relative path prefixes of modules whose code runs on >1 thread.
+THREADED_PREFIXES = (
+    "cluster_capacity_tpu/runtime/",
+    "cluster_capacity_tpu/obs/",
+    "cluster_capacity_tpu/serve/",
+    "cluster_capacity_tpu/utils/metrics.py",
+    "cluster_capacity_tpu/utils/events.py",
+)
+
+# (module suffix, function qualname) seeds for the LK005 walk.
+THREAD_ROOTS = (
+    # the watchdog worker loop: runs arbitrary guarded callables off-main
+    ("runtime.guard", "_Watchdog.run"),
+    # the daemon's dispatch/retry/restart/probe paths: a threaded front-end
+    # drives these from request threads
+    ("serve.supervisor", "Supervisor.drain"),
+    ("serve.supervisor", "Supervisor._attempt_rung"),
+    ("serve.supervisor", "Supervisor._restart_worker"),
+    ("serve.supervisor", "Supervisor._probe_stale"),
+)
+
+# Process-global JAX mutations (LK005).  Exact dotted names, plus any call
+# whose attribute is `cache_clear` (jit-factory LRU clears).
+HOSTILE_CALLS = {
+    "jax.config.update",
+    "jax.clear_caches",
+    "jax.experimental.enable_x64",
+    "jax.distributed.initialize",
+    "jax.distributed.shutdown",
+}
+HOSTILE_ATTRS = ("cache_clear",)
+
+# Blocking-call vocabulary (LK004): exact dotted names.  Device dispatch
+# entries ride in from irgate's DISPATCH_SET (tools/irgate/guard_audit.py)
+# so the two gates share one definition of "launches device work".
+BLOCKING_CALLS = {
+    "time.sleep",
+    "os.replace",
+    "os.makedirs",
+    "os.listdir",
+    "os.remove",
+    "os.rmdir",
+    "shutil.rmtree",
+    "shutil.copytree",
+    "subprocess.run",
+    "subprocess.call",
+    "subprocess.check_call",
+    "subprocess.check_output",
+    "subprocess.Popen",
+}
+# bare builtins that block on I/O
+BLOCKING_BUILTINS = {"open", "input"}
+# module-suffix endings whose calls are device dispatch or the guard choke
+# point itself (a guarded dispatch under a held lock serializes every
+# other thread behind a device solve)
+BLOCKING_SUFFIXES = ("runtime.guard.run",)
+# any resolved jax.* call under a lock is a dispatch/compile hazard
+BLOCKING_PREFIXES = ("jax.",)
+
+# Declarative guard registry (merged with inline `# cc-guarded-by:` /
+# `# cc-thread-confined:` / `# cc-holds:` annotations).
+GUARDS_PATH = "tools/concgate/guards.json"
+
+# Baseline location, relative to the repo root.  The tree ships an EMPTY
+# baseline: every tolerated finding is an inline suppression with a
+# reason, next to the code it excuses.
+BASELINE_PATH = "tools/concgate_baseline.json"

@@ -1,0 +1,719 @@
+"""concgate analysis context: modules, locks, the guard registry, and the
+per-function event streams every pass consumes.
+
+The context does one walk per function and emits four event streams —
+lock acquisitions, calls, guarded-name accesses, and branch nodes — each
+stamped with the *lexically held lock set* at that point.  Passes then
+reduce the streams: lock-order builds the acquisition graph from acquire
+and call events, guarded-state checks accesses against the registry,
+blocking-under-lock filters calls, and check-then-act inspects branches.
+
+Identity model: locks and guarded names are canonical *module-suffix*
+dotted names — ``runtime.faults._lock``, ``obs.spans.Collector._lock``
+(an instance lock declared in class scope), ``utils.metrics.Registry.
+counters`` (a guarded instance field).  The suffix drops the package
+prefix so guards.json stays readable and fixture modules in tests can
+reference real locks.
+
+Resolution is name-based like jaxlint's (tools/jaxlint/context.py):
+import aliases resolve ``faults._lock`` to the lock defined in
+runtime/faults.py, and module-level singleton instances resolve method
+calls and field accesses (``default_registry.render()`` →
+``utils.metrics.Registry.render``).  ``self`` resolves within the
+defining class.  Anything unresolvable stays out of the graph — the
+dynamic lock witness (witness.py) is the backstop for edges the static
+walk cannot see.
+
+Guard declarations come from two merged sources:
+
+- ``tools/concgate/guards.json`` — the declarative registry;
+- inline annotations on the declaring line::
+
+      _state = _State()          # cc-guarded-by: _lock
+      _sampling = {...}          # cc-thread-confined: <claim>
+      def _load_env_locked():    # cc-holds: _lock
+
+``cc-holds`` marks a function whose *caller* holds the lock (the
+``_locked`` suffix convention): its body is analyzed as if the lock were
+held, and interprocedural edges flow through it.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .common import Finding
+from .config import PKG
+
+_ANN_RE = re.compile(
+    r"#\s*cc-(guarded-by|thread-confined|holds):\s*(.+?)\s*$")
+
+_LOCK_CTORS = {"threading.Lock", "threading.RLock"}
+_CONFINED_CTORS = {"threading.local", "contextvars.ContextVar"}
+
+# method names that mutate their receiver (LK006's write detection)
+MUTATOR_METHODS = {"append", "add", "clear", "discard", "extend", "insert",
+                   "pop", "popitem", "remove", "setdefault", "update"}
+
+
+def suffix_of(dotted: str) -> str:
+    """Canonical module-suffix form: strip the package prefix."""
+    if dotted.startswith(PKG + "."):
+        return dotted[len(PKG) + 1:]
+    return dotted
+
+
+def module_key(relpath: str) -> str:
+    key = relpath[:-3].replace("/", ".").replace("\\", ".")
+    if key.endswith(".__init__"):
+        key = key[: -len(".__init__")]
+    return key
+
+
+@dataclass(frozen=True)
+class LockDef:
+    id: str             # suffix dotted, e.g. "runtime.faults._lock"
+    path: str
+    line: int
+    is_rlock: bool
+
+
+@dataclass
+class Guards:
+    """Merged declarative registry: guards.json + inline annotations."""
+
+    guarded: Dict[str, str] = field(default_factory=dict)   # var -> lock
+    confined: Dict[str, str] = field(default_factory=dict)  # var -> claim
+    holds: Dict[str, Set[str]] = field(default_factory=dict)  # func -> locks
+    findings: List[Finding] = field(default_factory=list)   # LK000s
+
+
+class FuncSummary:
+    """One function's event streams (held sets are lexical, including the
+    function's cc-holds preconditions)."""
+
+    def __init__(self, module: "ModuleInfo", qualname: str, node: ast.AST):
+        self.module = module
+        self.qualname = qualname        # "Collector.span", "_dump", ...
+        self.node = node
+        self.holds: Set[str] = set()
+        # (lock id, line, held-before tuple)
+        self.acquires: List[Tuple[str, int, Tuple[str, ...]]] = []
+        # (canonical dotted target or None, attr name or "", line, held)
+        self.calls: List[Tuple[Optional[str], str, int, Tuple[str, ...]]] = []
+        # (var id, is_write, line, held)
+        self.accesses: List[Tuple[str, bool, int, Tuple[str, ...]]] = []
+        # (If node, held)
+        self.checks: List[Tuple[ast.If, Tuple[str, ...]]] = []
+        self.local_names: Set[str] = set()
+        self.global_decls: Set[str] = set()
+        self.class_name: str = ""       # owning class for methods
+        self.is_module_body = qualname == "<module>"
+
+    @property
+    def ref(self) -> str:
+        """Canonical dotted: <module key>.<qualname> (locals stripped are
+        kept verbatim so nested defs stay addressable)."""
+        return f"{self.module.key}.{self.qualname}"
+
+
+class ModuleInfo:
+    def __init__(self, key: str, path: str, source: str):
+        self.key = key
+        self.suffix = suffix_of(key)
+        self.path = path
+        self.source = source
+        self.tree = ast.parse(source)
+        self.alias: Dict[str, str] = {}
+        self.classes: Dict[str, ast.ClassDef] = {}
+        self.funcs: Dict[str, FuncSummary] = {}     # qualname -> summary
+        self.annotations: List[Tuple[int, str, str]] = []  # (line, kind, val)
+        self._collect_aliases()
+        self._collect_defs()
+        self._collect_annotations()
+
+    def _collect_aliases(self) -> None:
+        pkg_parts = self.key.split(".")[:-1]
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for al in node.names:
+                    self.alias[al.asname or al.name.split(".")[0]] = (
+                        al.name if al.asname else al.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    base = pkg_parts[:len(pkg_parts) - (node.level - 1)]
+                    root = ".".join(base + ([node.module] if node.module
+                                            else []))
+                else:
+                    root = node.module or ""
+                for al in node.names:
+                    if al.name == "*":
+                        continue
+                    tgt = f"{root}.{al.name}" if root else al.name
+                    self.alias[al.asname or al.name] = tgt
+
+    def _collect_defs(self) -> None:
+        def visit(node: ast.AST, prefix: str, cls: str) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    q = f"{prefix}{child.name}"
+                    fs = FuncSummary(self, q, child)
+                    fs.class_name = cls
+                    self.funcs[q] = fs
+                    visit(child, f"{q}.<locals>.", "")
+                elif isinstance(child, ast.ClassDef):
+                    if not prefix:
+                        self.classes[child.name] = child
+                    visit(child, f"{prefix}{child.name}.",
+                          child.name if not prefix else "")
+                else:
+                    visit(child, prefix, cls)
+        visit(self.tree, "", "")
+        body = FuncSummary(self, "<module>", self.tree)
+        self.funcs["<module>"] = body
+        for fs in self.funcs.values():
+            self._collect_locals(fs)
+
+    def _collect_locals(self, fs: FuncSummary) -> None:
+        node = fs.node
+        if fs.is_module_body:
+            return
+        args = getattr(node, "args", None)
+        if args is not None:
+            for group in (getattr(args, "posonlyargs", []), args.args,
+                          args.kwonlyargs):
+                fs.local_names.update(a.arg for a in group)
+            for va in (args.vararg, args.kwarg):
+                if va is not None:
+                    fs.local_names.add(va.arg)
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Global):
+                fs.global_decls.update(sub.names)
+            elif isinstance(sub, ast.Name) and isinstance(
+                    sub.ctx, (ast.Store, ast.Del)):
+                fs.local_names.add(sub.id)
+
+    def _collect_annotations(self) -> None:
+        lines = self.source.splitlines()
+        for i, line in enumerate(lines, 1):
+            m = _ANN_RE.search(line)
+            if not m:
+                continue
+            at = i
+            if line.strip().startswith("#"):
+                # standalone comment: attach to the next code line (the
+                # comment block may continue across several lines)
+                for j in range(i, len(lines)):
+                    nxt = lines[j].strip()
+                    if nxt and not nxt.startswith("#"):
+                        at = j + 1
+                        break
+            self.annotations.append((at, m.group(1), m.group(2)))
+
+
+def _call_dotted(node: ast.AST) -> Optional[str]:
+    """Plain dotted spelling of an expression (no alias resolution)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _call_dotted(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+class Program:
+    """All modules plus the cross-module lock/instance/guard registries."""
+
+    def __init__(self, modules: Sequence[ModuleInfo],
+                 guards_doc: Optional[dict] = None):
+        self.modules = list(modules)
+        self.by_key = {m.key: m for m in self.modules}
+        self.locks: Dict[str, LockDef] = {}
+        self.instances: Dict[str, str] = {}   # instance dotted -> class dotted
+        self.funcs: Dict[str, FuncSummary] = {}
+        for m in self.modules:
+            for fs in m.funcs.values():
+                if not fs.is_module_body:
+                    self.funcs[fs.ref] = fs
+        for m in self.modules:
+            self._discover_locks(m)
+        for m in self.modules:
+            self._discover_instances(m)
+        self.guards = Guards()
+        self._load_guards_doc(guards_doc or {})
+        for m in self.modules:
+            self._apply_annotations(m)
+        for m in self.modules:
+            for fs in m.funcs.values():
+                _EventWalker(self, m, fs).run()
+
+    # -- lock discovery ----------------------------------------------------
+
+    def _lock_ctor(self, m: ModuleInfo, value: ast.AST) -> Optional[bool]:
+        """None = not a lock; else is_rlock.  Recognizes threading.Lock() /
+        RLock() directly and via dataclasses.field(default_factory=...)."""
+        if not isinstance(value, ast.Call):
+            return None
+        dotted = self.resolve(m, None, value.func)
+        if dotted in _LOCK_CTORS:
+            return dotted.endswith("RLock")
+        if dotted is not None and (dotted == "dataclasses.field"
+                                   or dotted.endswith(".field")
+                                   or dotted == "field"):
+            for kw in value.keywords:
+                if kw.arg == "default_factory":
+                    fac = self.resolve(m, None, kw.value)
+                    if fac in _LOCK_CTORS:
+                        return fac.endswith("RLock")
+        return None
+
+    def _discover_locks(self, m: ModuleInfo) -> None:
+        def targets(stmt) -> List[str]:
+            if isinstance(stmt, ast.Assign):
+                return [t.id for t in stmt.targets
+                        if isinstance(t, ast.Name)]
+            if isinstance(stmt, ast.AnnAssign) and isinstance(
+                    stmt.target, ast.Name):
+                return [stmt.target.id]
+            return []
+
+        def add(lock_id: str, line: int, rl: bool) -> None:
+            self.locks[lock_id] = LockDef(id=lock_id, path=m.path,
+                                          line=line, is_rlock=rl)
+
+        for stmt in m.tree.body:
+            val = getattr(stmt, "value", None)
+            rl = self._lock_ctor(m, val) if val is not None else None
+            if rl is not None:
+                for name in targets(stmt):
+                    add(f"{m.suffix}.{name}", stmt.lineno, rl)
+        for cname, cnode in m.classes.items():
+            for stmt in cnode.body:
+                val = getattr(stmt, "value", None)
+                rl = self._lock_ctor(m, val) if val is not None else None
+                if rl is not None:
+                    for name in targets(stmt):
+                        add(f"{m.suffix}.{cname}.{name}", stmt.lineno, rl)
+            init = m.funcs.get(f"{cname}.__init__")
+            if init is None:
+                continue
+            for stmt in ast.walk(init.node):
+                if not isinstance(stmt, ast.Assign):
+                    continue
+                rl = self._lock_ctor(m, stmt.value)
+                if rl is None:
+                    continue
+                for t in stmt.targets:
+                    if isinstance(t, ast.Attribute) and isinstance(
+                            t.value, ast.Name) and t.value.id == "self":
+                        add(f"{m.suffix}.{cname}.{t.attr}", stmt.lineno, rl)
+
+    def _discover_instances(self, m: ModuleInfo) -> None:
+        for stmt in m.tree.body:
+            if not isinstance(stmt, ast.Assign) or not isinstance(
+                    stmt.value, ast.Call):
+                continue
+            callee = self.resolve(m, None, stmt.value.func)
+            cls = self._class_of(callee)
+            if cls is None:
+                continue
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    self.instances[f"{m.key}.{t.id}"] = cls
+
+    def _class_of(self, dotted: Optional[str]) -> Optional[str]:
+        """dotted -> class dotted when it names a class in the program."""
+        if dotted is None or "." not in dotted:
+            return None
+        mod, _, name = dotted.rpartition(".")
+        owner = self.by_key.get(mod)
+        if owner is not None and name in owner.classes:
+            return dotted
+        return None
+
+    # -- guard registry ----------------------------------------------------
+
+    def _resolve_lock_ref(self, ref: str, m: Optional[ModuleInfo],
+                          cls: str = "") -> Optional[str]:
+        if ref in self.locks:
+            return ref
+        if m is not None:
+            if cls and f"{m.suffix}.{cls}.{ref}" in self.locks:
+                return f"{m.suffix}.{cls}.{ref}"
+            if f"{m.suffix}.{ref}" in self.locks:
+                return f"{m.suffix}.{ref}"
+        return None
+
+    def _var_module(self, var_id: str) -> Optional[ModuleInfo]:
+        """The module whose scope declares `var_id`, if in the program."""
+        parts = var_id.split(".")
+        for cut in (len(parts) - 1, len(parts) - 2):
+            if cut <= 0:
+                continue
+            mod_suffix = ".".join(parts[:cut])
+            for key in (f"{PKG}.{mod_suffix}", mod_suffix):
+                if key in self.by_key:
+                    return self.by_key[key]
+        return None
+
+    def _declare_guarded(self, var_id: str, lock_ref: str,
+                         m: Optional[ModuleInfo], cls: str,
+                         path: str, line: int) -> None:
+        lock = self._resolve_lock_ref(lock_ref, m, cls)
+        if lock is None:
+            # only a config error when the declaring module is actually in
+            # the program (guards.json entries for unscanned modules are
+            # inert, so a fixture run is not spammed with LK000s)
+            if m is not None:
+                self.guards.findings.append(Finding(
+                    path=path, line=line, rule="LK000",
+                    message=f"guard declaration for {var_id!r} names "
+                            f"unknown lock {lock_ref!r}"))
+            return
+        prev = self.guards.guarded.get(var_id)
+        if prev is not None and prev != lock:
+            self.guards.findings.append(Finding(
+                path=path, line=line, rule="LK000",
+                message=f"{var_id!r} declared guarded by both {prev!r} "
+                        f"and {lock!r}"))
+            return
+        self.guards.guarded[var_id] = lock
+
+    def _load_guards_doc(self, doc: dict) -> None:
+        for var_id, entry in sorted((doc.get("guarded") or {}).items()):
+            lock_ref = entry.get("lock") if isinstance(entry, dict) \
+                else str(entry)
+            m = self._var_module(var_id)
+            self._declare_guarded(var_id, lock_ref or "", m, "",
+                                  "tools/concgate/guards.json", 1)
+        for var_id, claim in sorted((doc.get("confined") or {}).items()):
+            self.guards.confined[var_id] = str(claim)
+        for func_id, lock_refs in sorted((doc.get("holds") or {}).items()):
+            refs = lock_refs if isinstance(lock_refs, list) else [lock_refs]
+            m = self._var_module(func_id)
+            resolved = set()
+            for ref in refs:
+                lock = self._resolve_lock_ref(str(ref), m)
+                if lock is None:
+                    if m is not None:
+                        self.guards.findings.append(Finding(
+                            path="tools/concgate/guards.json", line=1,
+                            rule="LK000",
+                            message=f"holds entry {func_id!r} names "
+                                    f"unknown lock {ref!r}"))
+                    continue
+                resolved.add(lock)
+            if resolved:
+                self.guards.holds.setdefault(func_id, set()).update(resolved)
+
+    def _apply_annotations(self, m: ModuleInfo) -> None:
+        # index declaring lines: module-level / class-body assigns and
+        # self-attr assigns in methods, plus def lines for cc-holds
+        assigns: Dict[int, List[Tuple[str, str]]] = {}  # line -> (var, cls)
+
+        def note(stmt, name: str, cls: str) -> None:
+            var = f"{m.suffix}.{cls}.{name}" if cls else f"{m.suffix}.{name}"
+            end = getattr(stmt, "end_lineno", None) or stmt.lineno
+            for ln in range(stmt.lineno, end + 1):
+                assigns.setdefault(ln, []).append((var, cls))
+
+        for stmt in m.tree.body:
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        note(stmt, t.id, "")
+            elif isinstance(stmt, ast.AnnAssign) and isinstance(
+                    stmt.target, ast.Name):
+                note(stmt, stmt.target.id, "")
+        for cname, cnode in m.classes.items():
+            for stmt in cnode.body:
+                if isinstance(stmt, ast.Assign):
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            note(stmt, t.id, cname)
+                elif isinstance(stmt, ast.AnnAssign) and isinstance(
+                        stmt.target, ast.Name):
+                    note(stmt, stmt.target.id, cname)
+        for fs in m.funcs.values():
+            if fs.is_module_body or not fs.class_name:
+                continue
+            for stmt in ast.walk(fs.node):
+                if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                    continue
+                tgts = stmt.targets if isinstance(stmt, ast.Assign) \
+                    else [stmt.target]
+                for t in tgts:
+                    if isinstance(t, ast.Attribute) and isinstance(
+                            t.value, ast.Name) and t.value.id == "self":
+                        note(stmt, t.attr, fs.class_name)
+
+        defs: Dict[int, FuncSummary] = {}
+        for fs in m.funcs.values():
+            if not fs.is_module_body:
+                defs.setdefault(fs.node.lineno, fs)
+
+        for line, kind, value in m.annotations:
+            if kind == "holds":
+                fs = defs.get(line)
+                if fs is None:
+                    self.guards.findings.append(Finding(
+                        path=m.path, line=line, rule="LK000",
+                        message="cc-holds annotation is not on a `def` "
+                                "line"))
+                    continue
+                for ref in value.split(","):
+                    ref = ref.strip().split()[0] if ref.strip() else ""
+                    lock = self._resolve_lock_ref(ref, m, fs.class_name)
+                    if lock is None:
+                        self.guards.findings.append(Finding(
+                            path=m.path, line=line, rule="LK000",
+                            message=f"cc-holds names unknown lock "
+                                    f"{ref!r}"))
+                        continue
+                    self.guards.holds.setdefault(
+                        f"{m.suffix}.{fs.qualname}", set()).add(lock)
+                continue
+            targets = assigns.get(line)
+            if not targets:
+                self.guards.findings.append(Finding(
+                    path=m.path, line=line, rule="LK000",
+                    message=f"cc-{kind} annotation is not on a module-"
+                            "level, class-body, or self-attribute "
+                            "assignment line"))
+                continue
+            for var, cls in targets:
+                if kind == "guarded-by":
+                    ref = value.split()[0]
+                    self._declare_guarded(var, ref, m, cls, m.path, line)
+                else:
+                    self.guards.confined[var] = value
+
+    def holds_of(self, fs: FuncSummary) -> Set[str]:
+        return set(self.guards.holds.get(
+            f"{fs.module.suffix}.{fs.qualname}", ())) | fs.holds
+
+    # -- resolution --------------------------------------------------------
+
+    def resolve(self, m: ModuleInfo, fs: Optional[FuncSummary],
+                node: ast.AST) -> Optional[str]:
+        """Canonical dotted name of an expression: aliases resolved,
+        ``self`` bound to the enclosing class, module-level singleton
+        instances mapped to their class."""
+        if isinstance(node, ast.Name):
+            if node.id == "self" and fs is not None and fs.class_name:
+                return f"{m.key}.{fs.class_name}"
+            if node.id in m.alias:
+                return m.alias[node.id]
+            if node.id in m.classes:
+                return f"{m.key}.{node.id}"
+            if f"{m.key}.{node.id}" in self.instances:
+                return f"{m.key}.{node.id}"
+            cand = m.funcs.get(node.id)
+            if cand is not None and (fs is None
+                                     or node.id not in fs.local_names):
+                return cand.ref
+            if f"{m.suffix}.{node.id}" in self.locks and (
+                    fs is None or node.id not in fs.local_names
+                    or node.id in fs.global_decls):
+                return f"{m.key}.{node.id}"     # module-global lock
+            return None
+        if isinstance(node, ast.Attribute):
+            base = self.resolve(m, fs, node.value)
+            if base is None:
+                return None
+            base = self.instances.get(base, base)
+            return f"{base}.{node.attr}"
+        return None
+
+    def resolve_lock(self, m: ModuleInfo, fs: Optional[FuncSummary],
+                     node: ast.AST) -> Optional[str]:
+        dotted = self.resolve(m, fs, node)
+        if dotted is None:
+            return None
+        sfx = suffix_of(dotted)
+        return sfx if sfx in self.locks else None
+
+    def resolve_var(self, m: ModuleInfo, fs: Optional[FuncSummary],
+                    node: ast.AST) -> Optional[str]:
+        """Guard-registry id for a Name/Attribute access, or None."""
+        declared = self.guards.guarded.keys() | self.guards.confined.keys()
+        if isinstance(node, ast.Name):
+            var = f"{m.suffix}.{node.id}"
+            if var not in declared:
+                return None
+            if fs is not None and not fs.is_module_body \
+                    and node.id in fs.local_names \
+                    and node.id not in fs.global_decls:
+                return None     # shadowed by a local binding
+            return var
+        if isinstance(node, ast.Attribute):
+            dotted = self.resolve(m, fs, node)
+            if dotted is None:
+                return None
+            var = suffix_of(dotted)
+            return var if var in declared else None
+        return None
+
+    def lookup_func(self, dotted: Optional[str]) -> Optional[FuncSummary]:
+        if dotted is None:
+            return None
+        fs = self.funcs.get(dotted)
+        if fs is not None:
+            return fs
+        # constructor: Class(...) runs Class.__init__
+        cls = self._class_of(dotted)
+        if cls is not None:
+            return self.funcs.get(f"{cls}.__init__")
+        return None
+
+
+class _EventWalker:
+    """One pass over a function body, tracking the lexically held lock set
+    (``with`` blocks, sequential ``.acquire()``/``.release()`` pairs, and
+    the function's cc-holds preconditions)."""
+
+    def __init__(self, prog: Program, m: ModuleInfo, fs: FuncSummary):
+        self.prog = prog
+        self.m = m
+        self.fs = fs
+
+    def run(self) -> None:
+        held = tuple(sorted(self.prog.holds_of(self.fs)))
+        node = self.fs.node
+        if self.fs.is_module_body:
+            self._block(node.body, held)
+        else:
+            self._block(node.body, held)
+
+    # -- statements --------------------------------------------------------
+
+    def _block(self, stmts, held: Tuple[str, ...]) -> None:
+        extra: List[str] = []   # .acquire()d locks live to end of block
+        for stmt in stmts:
+            cur = held + tuple(l for l in extra if l not in held)
+            acq = self._acquire_release(stmt, cur)
+            if acq is not None:
+                kind, lock = acq
+                if kind == "acquire" and lock not in extra:
+                    extra.append(lock)
+                elif kind == "release" and lock in extra:
+                    extra.remove(lock)
+                continue
+            self._stmt(stmt, cur)
+
+    def _acquire_release(self, stmt, held) -> Optional[Tuple[str, str]]:
+        if not (isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Call)
+                and isinstance(stmt.value.func, ast.Attribute)
+                and stmt.value.func.attr in ("acquire", "release")):
+            return None
+        lock = self.prog.resolve_lock(self.m, self.fs,
+                                      stmt.value.func.value)
+        if lock is None:
+            return None
+        if stmt.value.func.attr == "acquire":
+            self.fs.acquires.append((lock, stmt.lineno, held))
+            return ("acquire", lock)
+        return ("release", lock)
+
+    def _stmt(self, stmt, held: Tuple[str, ...]) -> None:
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            acquired: List[str] = []
+            for item in stmt.items:
+                lock = self.prog.resolve_lock(self.m, self.fs,
+                                              item.context_expr)
+                if lock is not None:
+                    self.fs.acquires.append(
+                        (lock, item.context_expr.lineno,
+                         held + tuple(acquired)))
+                    acquired.append(lock)
+                else:
+                    self._expr(item.context_expr, held + tuple(acquired))
+                if item.optional_vars is not None:
+                    self._expr(item.optional_vars, held + tuple(acquired))
+            self._block(stmt.body, held + tuple(
+                l for l in acquired if l not in held))
+            return
+        if isinstance(stmt, ast.If):
+            self.fs.checks.append((stmt, held))
+            self._expr(stmt.test, held)
+            self._block(stmt.body, held)
+            self._block(stmt.orelse, held)
+            return
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return      # nested defs get their own summaries
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._expr(stmt.target, held)
+            self._expr(stmt.iter, held)
+            self._block(stmt.body, held)
+            self._block(stmt.orelse, held)
+            return
+        if isinstance(stmt, ast.While):
+            self._expr(stmt.test, held)
+            self._block(stmt.body, held)
+            self._block(stmt.orelse, held)
+            return
+        if isinstance(stmt, ast.Try):
+            self._block(stmt.body, held)
+            for h in stmt.handlers:
+                if h.type is not None:
+                    self._expr(h.type, held)
+                self._block(h.body, held)
+            self._block(stmt.orelse, held)
+            self._block(stmt.finalbody, held)
+            return
+        # leaf statements: walk every expression child
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._expr(child, held)
+
+    # -- expressions -------------------------------------------------------
+
+    def _expr(self, node, held: Tuple[str, ...]) -> None:
+        if isinstance(node, ast.Call):
+            func = node.func
+            if isinstance(func, ast.Attribute) \
+                    and func.attr in ("acquire", "release"):
+                lock = self.prog.resolve_lock(self.m, self.fs, func.value)
+                if lock is not None:
+                    if func.attr == "acquire":
+                        self.fs.acquires.append((lock, node.lineno, held))
+                    for arg in node.args:
+                        self._expr(arg, held)
+                    return
+            target = self.prog.resolve(self.m, self.fs, func)
+            if target is None:
+                target = _call_dotted(func)
+            attr = func.attr if isinstance(func, ast.Attribute) else ""
+            self.fs.calls.append((target, attr, node.lineno, held))
+            self._expr(func, held)
+            for arg in node.args:
+                self._expr(arg, held)
+            for kw in node.keywords:
+                self._expr(kw.value, held)
+            return
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            var = self.prog.resolve_var(self.m, self.fs, node)
+            if var is not None:
+                is_write = isinstance(getattr(node, "ctx", None),
+                                      (ast.Store, ast.Del))
+                self.fs.accesses.append((var, is_write, node.lineno, held))
+            if isinstance(node, ast.Attribute):
+                self._expr(node.value, held)
+            return
+        if isinstance(node, ast.Lambda):
+            self._expr(node.body, held)
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._expr(child, held)
+            elif isinstance(child, ast.comprehension):
+                self._expr(child.target, held)
+                self._expr(child.iter, held)
+                for cond in child.ifs:
+                    self._expr(cond, held)

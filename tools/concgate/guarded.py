@@ -1,0 +1,186 @@
+"""LK002 / LK003 / LK006: guarded-state discipline.
+
+LK002 — a declared-guarded name is read or written while its lock is not
+in the lexical held set (and the enclosing function carries no matching
+``cc-holds``).  Module body and the declaring class's ``__init__`` are
+exempt: both run before the object is shared.
+
+LK003 — a module-level mutable global in a threaded module
+(config.THREADED_PREFIXES) with no declaration at all.  The point is to
+make the registry complete: every shared name is either guarded by a
+named lock, confined with a written claim, or a lock itself.  Constant-
+convention names (ALL_CAPS), immutable literals, dunders, and module-
+level singletons of lock-owning classes ("internally synchronized") are
+exempt.
+
+LK006 — check-then-act: a branch whose test reads a guarded name and
+whose body mutates the same name, with the lock held for neither.  Each
+observation is racy on its own (LK002 fires too); LK006 points out that
+even fixing both halves independently leaves a lost-update window unless
+one `with` spans the pair.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from .common import Finding
+from .config import THREADED_PREFIXES
+from .context import (MUTATOR_METHODS, FuncSummary, ModuleInfo, Program,
+                      suffix_of)
+
+
+def _exempt_scope(fs: FuncSummary, var: str) -> bool:
+    if fs.is_module_body:
+        return True     # import-time is single-threaded by interpreter lock
+    if fs.class_name and fs.qualname == f"{fs.class_name}.__init__" \
+            and var.startswith(
+                f"{fs.module.suffix}.{fs.class_name}."):
+        return True     # constructing thread owns the object exclusively
+    return False
+
+
+def check_lk002(prog: Program) -> List[Finding]:
+    findings: List[Finding] = []
+    for m in prog.modules:
+        for fs in m.funcs.values():
+            for var, is_write, line, held in fs.accesses:
+                lock = prog.guards.guarded.get(var)
+                if lock is None or lock in held:
+                    continue
+                if _exempt_scope(fs, var):
+                    continue
+                verb = "write to" if is_write else "read of"
+                findings.append(Finding(
+                    path=m.path, line=line, rule="LK002",
+                    message=f"{verb} {var} outside `with {lock}` "
+                            f"(in {m.suffix}.{fs.qualname})"))
+    return findings
+
+
+_IMMUTABLE_VALUES = (ast.Constant, ast.Tuple, ast.JoinedStr)
+_MUTABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                     ast.DictComp, ast.SetComp)
+_SAFE_CTORS = {"threading.Lock", "threading.RLock", "threading.local",
+               "contextvars.ContextVar", "re.compile", "frozenset",
+               "itertools.count"}  # count: next() is a single atomic bytecode
+
+
+def _class_owns_lock(prog: Program, cls_dotted: str) -> bool:
+    prefix = suffix_of(cls_dotted) + "."
+    return any(lock.startswith(prefix) for lock in prog.locks)
+
+
+def _is_threaded(path: str) -> bool:
+    return any(path.startswith(p) or path == p for p in THREADED_PREFIXES)
+
+
+def check_lk003(prog: Program) -> List[Finding]:
+    findings: List[Finding] = []
+    declared = (prog.guards.guarded.keys() | prog.guards.confined.keys()
+                | prog.locks.keys())
+    for m in prog.modules:
+        if not _is_threaded(m.path):
+            continue
+        for stmt in m.tree.body:
+            if isinstance(stmt, ast.Assign):
+                names = [t.id for t in stmt.targets
+                         if isinstance(t, ast.Name)]
+                value: Optional[ast.AST] = stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and isinstance(
+                    stmt.target, ast.Name):
+                names = [stmt.target.id]
+                value = stmt.value
+            else:
+                continue
+            if value is None or isinstance(value, _IMMUTABLE_VALUES):
+                continue
+            for name in names:
+                var = f"{m.suffix}.{name}"
+                if var in declared:
+                    continue
+                if name.startswith("__") or name.strip("_").isupper():
+                    continue
+                if isinstance(value, _MUTABLE_LITERALS):
+                    kind = type(value).__name__.lower()
+                elif isinstance(value, ast.Call):
+                    dotted = prog.resolve(m, None, value.func)
+                    if dotted in _SAFE_CTORS:
+                        continue
+                    cls = prog._class_of(dotted)
+                    if cls is not None and _class_owns_lock(prog, cls):
+                        continue    # internally synchronized singleton
+                    kind = "call result"
+                else:
+                    continue    # names, attributes: aliases, not new state
+                findings.append(Finding(
+                    path=m.path, line=stmt.lineno, rule="LK003",
+                    message=f"undeclared module-level mutable global "
+                            f"{var} ({kind}) in a threaded module; "
+                            f"annotate `# cc-guarded-by:` or "
+                            f"`# cc-thread-confined:`"))
+    return findings
+
+
+def _guarded_reads(prog: Program, m: ModuleInfo, fs: FuncSummary,
+                   node: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, (ast.Name, ast.Attribute)):
+            var = prog.resolve_var(m, fs, sub)
+            if var is not None and var in prog.guards.guarded:
+                out.add(var)
+    return out
+
+
+def _mutated_vars(prog: Program, m: ModuleInfo, fs: FuncSummary,
+                  stmts) -> Set[str]:
+    out: Set[str] = set()
+    for stmt in stmts:
+        for sub in ast.walk(stmt):
+            if isinstance(sub, (ast.Name, ast.Attribute)) and isinstance(
+                    sub.ctx, (ast.Store, ast.Del)):
+                var = prog.resolve_var(m, fs, sub)
+                if var is not None:
+                    out.add(var)
+            elif isinstance(sub, ast.Subscript) and isinstance(
+                    sub.ctx, (ast.Store, ast.Del)):
+                var = prog.resolve_var(m, fs, sub.value)
+                if var is not None:
+                    out.add(var)
+            elif isinstance(sub, ast.Call) and isinstance(
+                    sub.func, ast.Attribute) \
+                    and sub.func.attr in MUTATOR_METHODS:
+                var = prog.resolve_var(m, fs, sub.func.value)
+                if var is not None:
+                    out.add(var)
+    return out
+
+
+def check_lk006(prog: Program) -> List[Finding]:
+    findings: List[Finding] = []
+    for m in prog.modules:
+        for fs in m.funcs.values():
+            if fs.is_module_body:
+                continue
+            for if_node, held in fs.checks:
+                read = _guarded_reads(prog, m, fs, if_node.test)
+                if not read:
+                    continue
+                mutated = _mutated_vars(prog, m, fs, if_node.body)
+                for var in sorted(read & mutated):
+                    lock = prog.guards.guarded[var]
+                    if lock in held:
+                        continue
+                    findings.append(Finding(
+                        path=m.path, line=if_node.lineno, rule="LK006",
+                        message=f"check-then-act on {var}: the test reads "
+                                f"it and the branch body mutates it, but "
+                                f"{lock} does not span the pair (in "
+                                f"{m.suffix}.{fs.qualname})"))
+    return findings
+
+
+def check(prog: Program) -> List[Finding]:
+    return check_lk002(prog) + check_lk003(prog) + check_lk006(prog)

@@ -1,0 +1,81 @@
+"""LK005: thread-hostile JAX mutations reachable from threaded code.
+
+BFS over the resolvable call graph from config.THREAD_ROOTS (the
+watchdog worker loop, the daemon's retry/restart/probe paths, and the
+recompile listener that runs on compile threads).  Any function on that
+frontier that performs a process-global JAX mutation — config updates,
+cache clears, x64 toggles, distributed init/shutdown, or a factory
+``.cache_clear()`` — is flagged with the full call chain from the root,
+because the fix is usually hoisting the mutation to startup, not
+deleting the call.
+
+The walk is name-resolution-bound: calls through dynamic dispatch
+(``fn(*args)`` inside ``guard.run``) are invisible, which is exactly why
+the roots include the *callers* of guard.run — anything they invoke
+directly is covered, and the dynamic witness plus the chaos soak cover
+the rest at runtime.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from .common import Finding
+from .config import HOSTILE_ATTRS, HOSTILE_CALLS, PKG, THREAD_ROOTS
+from .context import FuncSummary, Program
+
+
+def _root_funcs(prog: Program) -> List[FuncSummary]:
+    out: List[FuncSummary] = []
+    for mod_suffix, qualname in THREAD_ROOTS:
+        for key in (f"{PKG}.{mod_suffix}", mod_suffix):
+            fs = prog.funcs.get(f"{key}.{qualname}")
+            if fs is not None:
+                out.append(fs)
+                break
+    return out
+
+
+def _chain(parents: Dict[str, Optional[str]], ref: str) -> str:
+    hops: List[str] = []
+    cur: Optional[str] = ref
+    while cur is not None:
+        hops.append(cur)
+        cur = parents[cur]
+    hops.reverse()
+    return " -> ".join(h.split(f"{PKG}.", 1)[-1] for h in hops)
+
+
+def check(prog: Program) -> List[Finding]:
+    findings: List[Finding] = []
+    parents: Dict[str, Optional[str]] = {}
+    queue: deque = deque()
+    for fs in _root_funcs(prog):
+        if fs.ref not in parents:
+            parents[fs.ref] = None
+            queue.append(fs)
+    seen_sites: set = set()
+    while queue:
+        fs = queue.popleft()
+        for target, attr, line, _held in fs.calls:
+            hostile: Optional[str] = None
+            if target in HOSTILE_CALLS:
+                hostile = target
+            elif attr in HOSTILE_ATTRS:
+                hostile = target if target else f"<expr>.{attr}"
+            if hostile is not None:
+                site: Tuple[str, int, str] = (fs.module.path, line, hostile)
+                if site not in seen_sites:
+                    seen_sites.add(site)
+                    findings.append(Finding(
+                        path=fs.module.path, line=line, rule="LK005",
+                        message=f"thread-hostile {hostile} reachable from "
+                                f"a thread root via "
+                                f"{_chain(parents, fs.ref)}"))
+                continue
+            callee = prog.lookup_func(target)
+            if callee is not None and callee.ref not in parents:
+                parents[callee.ref] = fs.ref
+                queue.append(callee)
+    return findings

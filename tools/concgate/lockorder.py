@@ -1,0 +1,198 @@
+"""LK001: the global lock-acquisition-order graph.
+
+An edge A -> B means "some code path acquires B while already holding A".
+Edges come from two places:
+
+- direct: an acquire event whose held set is non-empty;
+- interprocedural: a call made while holding A to a function whose
+  *transitive* acquire set (fixpoint over the resolvable call graph)
+  contains B.
+
+A cycle in the graph is a deadlock schedule: two threads can each hold
+one lock of the cycle and wait forever on the next.  The finding names
+BOTH acquisition paths (file:line of each edge's witness) so the fix —
+picking one global order — is mechanical.
+
+A self-edge is the degenerate cycle: re-acquiring a non-reentrant lock
+already held (RLock re-entry is legal and produces no edge).
+
+The edge list is exported (``lock_graph``) for the dynamic witness
+(witness.py), which asserts that runtime acquisition order stays inside
+the statically modelled graph.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Set, Tuple
+
+from .common import Finding
+from .context import Program
+
+
+class Edge:
+    __slots__ = ("src", "dst", "path", "line", "via")
+
+    def __init__(self, src: str, dst: str, path: str, line: int, via: str):
+        self.src = src
+        self.dst = dst
+        self.path = path
+        self.line = line
+        self.via = via
+
+    def describe(self) -> str:
+        return (f"{self.src} -> {self.dst} ({self.via} at "
+                f"{self.path}:{self.line})")
+
+
+def transitive_acquires(prog: Program) -> Dict[str, Set[str]]:
+    """Fixpoint: locks each function may acquire, directly or through any
+    resolvable callee.  cc-holds locks are NOT included — the caller, not
+    the callee, performs those acquisitions."""
+    trans: Dict[str, Set[str]] = {
+        ref: {lock for lock, _, _ in fs.acquires}
+        for ref, fs in prog.funcs.items()
+    }
+    changed = True
+    while changed:
+        changed = False
+        for ref, fs in prog.funcs.items():
+            acc = trans[ref]
+            before = len(acc)
+            for target, _attr, _line, _held in fs.calls:
+                callee = prog.lookup_func(target)
+                if callee is not None and callee.ref in trans:
+                    acc |= trans[callee.ref]
+            if len(acc) != before:
+                changed = True
+    return trans
+
+
+def lock_graph(prog: Program) -> List[Edge]:
+    trans = transitive_acquires(prog)
+    edges: List[Edge] = []
+    for m in prog.modules:
+        for fs in m.funcs.values():
+            where = f"{m.suffix}.{fs.qualname}"
+            for lock, line, held in fs.acquires:
+                for h in held:
+                    if h == lock:
+                        if not prog.locks[lock].is_rlock:
+                            edges.append(Edge(h, lock, m.path, line,
+                                              f"{where} re-acquires"))
+                        continue
+                    edges.append(Edge(h, lock, m.path, line,
+                                      f"{where} acquires"))
+            for target, _attr, line, held in fs.calls:
+                if not held:
+                    continue
+                callee = prog.lookup_func(target)
+                if callee is None:
+                    continue
+                for lock in sorted(trans.get(callee.ref, ())):
+                    for h in held:
+                        if h == lock:
+                            if not prog.locks[lock].is_rlock:
+                                edges.append(Edge(
+                                    h, lock, m.path, line,
+                                    f"{where} calls "
+                                    f"{callee.module.suffix}."
+                                    f"{callee.qualname} which re-acquires"))
+                            continue
+                        edges.append(Edge(
+                            h, lock, m.path, line,
+                            f"{where} calls {callee.module.suffix}."
+                            f"{callee.qualname} which acquires"))
+    return edges
+
+
+def _cycles(edges: List[Edge]) -> List[List[Edge]]:
+    """One witness cycle per strongly-connected component (plus every
+    self-edge).  A full cycle census is overkill for a gate: one named
+    cycle per SCC is enough to fail the build and point at the fix."""
+    adj: Dict[str, List[Edge]] = {}
+    for e in edges:
+        adj.setdefault(e.src, []).append(e)
+
+    # Tarjan SCC
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[Set[str]] = []
+    counter = [0]
+
+    def strong(v: str) -> None:
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        for e in adj.get(v, ()):
+            w = e.dst
+            if w not in index:
+                strong(w)
+                low[v] = min(low[v], low[w])
+            elif w in on_stack:
+                low[v] = min(low[v], index[w])
+        if low[v] == index[v]:
+            comp: Set[str] = set()
+            while True:
+                w = stack.pop()
+                on_stack.discard(w)
+                comp.add(w)
+                if w == v:
+                    break
+            sccs.append(comp)
+
+    nodes = sorted({e.src for e in edges} | {e.dst for e in edges})
+    for v in nodes:
+        if v not in index:
+            strong(v)
+
+    out: List[List[Edge]] = []
+    for e in edges:
+        if e.src == e.dst:
+            out.append([e])
+    for comp in sccs:
+        if len(comp) < 2:
+            continue
+        # walk one cycle inside the component, deterministically
+        start = min(comp)
+        path: List[Edge] = []
+        seen = {start}
+        cur = start
+        while True:
+            step = next(e for e in sorted(
+                adj.get(cur, ()), key=lambda e: (e.dst, e.path, e.line))
+                if e.dst in comp and e.src != e.dst)
+            path.append(step)
+            if step.dst == start:
+                break
+            if step.dst in seen:
+                # lasso: trim the tail before the repeated node
+                first = next(i for i, pe in enumerate(path)
+                             if pe.src == step.dst)
+                path = path[first:]
+                break
+            seen.add(step.dst)
+            cur = step.dst
+        out.append(path)
+    return out
+
+
+def check(prog: Program) -> Tuple[List[Finding], List[Edge]]:
+    edges = lock_graph(prog)
+    findings: List[Finding] = []
+    for cyc in _cycles(edges):
+        if len(cyc) == 1 and cyc[0].src == cyc[0].dst:
+            e = cyc[0]
+            findings.append(Finding(
+                path=e.path, line=e.line, rule="LK001",
+                message=f"self-deadlock on non-reentrant {e.src}: "
+                        f"{e.describe()}"))
+            continue
+        order = " -> ".join([cyc[0].src] + [e.dst for e in cyc])
+        paths = "; ".join(e.describe() for e in cyc)
+        findings.append(Finding(
+            path=cyc[0].path, line=cyc[0].line, rule="LK001",
+            message=f"lock-order cycle {order}: {paths}"))
+    return findings, edges

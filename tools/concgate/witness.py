@@ -1,0 +1,200 @@
+"""Dynamic lock witness: runtime confirmation of the static LK001 graph.
+
+Static analysis sees lexical acquisitions; dynamic dispatch (``fn(*args)``
+inside ``guard.run``, listener callbacks, monkeypatched hooks) can
+acquire locks the walker never connects.  The witness closes that gap:
+``WitnessedLock`` wraps a real lock, keeps a per-thread stack of held
+witness names, and records every (held -> acquired) pair it observes.
+
+After a run (the chaos soak, the 8-thread fuzz test):
+
+- ``violations(static_edges)`` — cycles in the union of witnessed and
+  statically-modelled edges.  Any entry is a real deadlock schedule that
+  actually part-executed; the gate must fail.
+- ``unmodeled(static_edges)`` — witnessed edges the static graph lacks.
+  In a strict harness (the fuzz test, which pins its inputs) this must
+  be empty; the soak merely reports them, because fault injection can
+  drive paths through dynamic dispatch the walker cannot see.
+
+Recording happens BEFORE the underlying acquire blocks, so a deadlock in
+progress still leaves its edge in the log.  RLock re-entry (the name is
+already on the thread's stack) records no edge — re-entry is not an
+ordering event.
+
+Opt-in: ``install_defaults()`` swaps the witness in for the six
+process-wide locks (faults, watchdog pool, recompile tallies, flight
+dumps, span collector, metric registry, event recorder);
+``install_supervisor()`` covers a Supervisor instance.  tools/soak.py
+enables it under ``CC_LOCK_WITNESS=1``.
+"""
+
+from __future__ import annotations
+
+import importlib
+import threading
+from typing import Callable, Dict, List, Set, Tuple
+
+Edge = Tuple[str, str]
+
+
+class Witness:
+    def __init__(self) -> None:
+        self._edges: Dict[Edge, str] = {}   # edge -> first witness thread
+        self._tls = threading.local()
+        self._mu = threading.Lock()
+
+    # -- per-thread held stack --------------------------------------------
+
+    def _stack(self) -> List[str]:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = []
+            self._tls.stack = st
+        return st
+
+    def note_acquire(self, name: str) -> None:
+        st = self._stack()
+        if name not in st:      # re-entry is not an ordering event
+            for held in st:
+                edge = (held, name)
+                if edge not in self._edges:
+                    with self._mu:
+                        self._edges.setdefault(
+                            edge, threading.current_thread().name)
+        st.append(name)
+
+    def note_release(self, name: str) -> None:
+        st = self._stack()
+        for i in range(len(st) - 1, -1, -1):
+            if st[i] == name:
+                del st[i]
+                break
+
+    # -- reporting ---------------------------------------------------------
+
+    def edges(self) -> Set[Edge]:
+        with self._mu:
+            return set(self._edges)
+
+    def unmodeled(self, static_edges: Set[Edge]) -> List[str]:
+        out = []
+        with self._mu:
+            for (src, dst), thread in sorted(self._edges.items()):
+                if (src, dst) not in static_edges:
+                    out.append(f"{src} -> {dst} (witnessed on thread "
+                               f"{thread}, absent from the static graph)")
+        return out
+
+    def violations(self, static_edges: Set[Edge]) -> List[str]:
+        """Cycles in witnessed-union-static edges.  Each is a deadlock
+        schedule at least one edge of which actually executed."""
+        graph: Dict[str, Set[str]] = {}
+        for src, dst in self.edges() | set(static_edges):
+            graph.setdefault(src, set()).add(dst)
+        out: List[str] = []
+        state: Dict[str, int] = {}      # 0 visiting, 1 done
+        path: List[str] = []
+
+        def dfs(v: str) -> None:
+            state[v] = 0
+            path.append(v)
+            for w in sorted(graph.get(v, ())):
+                if w not in state:
+                    dfs(w)
+                elif state[w] == 0:
+                    cyc = path[path.index(w):] + [w]
+                    out.append(" -> ".join(cyc))
+            path.pop()
+            state[v] = 1
+
+        for v in sorted(graph):
+            if v not in state:
+                dfs(v)
+        return out
+
+
+class WitnessedLock:
+    """Transparent proxy over a real Lock/RLock that reports to a
+    Witness.  Supports the context-manager protocol and explicit
+    acquire/release; everything else passes through."""
+
+    def __init__(self, name: str, inner, witness: Witness):
+        self._cc_name = name
+        self._cc_inner = inner
+        self._cc_witness = witness
+
+    def acquire(self, *args, **kwargs):
+        self._cc_witness.note_acquire(self._cc_name)
+        ok = self._cc_inner.acquire(*args, **kwargs)
+        if not ok:      # timed-out / non-blocking miss: not actually held
+            self._cc_witness.note_release(self._cc_name)
+        return ok
+
+    def release(self):
+        self._cc_inner.release()
+        self._cc_witness.note_release(self._cc_name)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __getattr__(self, name):
+        return getattr(self._cc_inner, name)
+
+
+# (module, attribute holding the lock, static lock id)
+_MODULE_SITES = (
+    ("cluster_capacity_tpu.runtime.faults", "_lock",
+     "runtime.faults._lock"),
+    ("cluster_capacity_tpu.runtime.guard", "_watchdog_lock",
+     "runtime.guard._watchdog_lock"),
+    ("cluster_capacity_tpu.obs.recompile", "_lock",
+     "obs.recompile._lock"),
+    ("cluster_capacity_tpu.obs.flight", "_dump_lock",
+     "obs.flight._dump_lock"),
+)
+# (module, singleton attribute, lock attribute, static lock id)
+_INSTANCE_SITES = (
+    ("cluster_capacity_tpu.obs.spans", "default_collector", "_lock",
+     "obs.spans.Collector._lock"),
+    ("cluster_capacity_tpu.utils.metrics", "default_registry", "_lock",
+     "utils.metrics.Registry._lock"),
+    ("cluster_capacity_tpu.utils.events", "default_recorder", "_lock",
+     "utils.events.Recorder._lock"),
+)
+
+
+def install_defaults(witness: Witness) -> Callable[[], None]:
+    """Swap WitnessedLock proxies in for the process-wide locks.
+    Returns an uninstall callable restoring the originals."""
+    restores: List[Callable[[], None]] = []
+    for mod_name, attr, lock_id in _MODULE_SITES:
+        mod = importlib.import_module(mod_name)
+        orig = getattr(mod, attr)
+        setattr(mod, attr, WitnessedLock(lock_id, orig, witness))
+        restores.append(lambda m=mod, a=attr, o=orig: setattr(m, a, o))
+    for mod_name, obj_attr, attr, lock_id in _INSTANCE_SITES:
+        mod = importlib.import_module(mod_name)
+        obj = getattr(mod, obj_attr)
+        orig = getattr(obj, attr)
+        setattr(obj, attr, WitnessedLock(lock_id, orig, witness))
+        restores.append(lambda o=obj, a=attr, v=orig: setattr(o, a, v))
+
+    def uninstall() -> None:
+        for restore in reversed(restores):
+            restore()
+    return uninstall
+
+
+def install_supervisor(sup, witness: Witness) -> Callable[[], None]:
+    orig = sup._lock
+    sup._lock = WitnessedLock("serve.supervisor.Supervisor._lock", orig,
+                              witness)
+
+    def uninstall() -> None:
+        sup._lock = orig
+    return uninstall

@@ -28,6 +28,9 @@ HOT_DIR_PREFIXES = (
     # capacity-bracket kernels run before every pruned sweep: a stray sync
     # there would serialize the one batched shot pruning is supposed to be
     "cluster_capacity_tpu/bounds/",
+    # the daemon's drain path sits upstream of every guarded dispatch; a
+    # sync in coalescing/probing code stalls the whole request batch
+    "cluster_capacity_tpu/serve/",
 )
 
 # Function qualnames allowed to synchronize with the device.  A sync call

@@ -75,6 +75,19 @@ MAX_POD_POOL = 6
 FAULT_SITES = None          # set after imports (faults module constants)
 
 
+def _concgate_files() -> List[str]:
+    """Repo-relative .py files for the witness's static-graph comparison
+    (same walk as concgate's CLI)."""
+    rels: List[str] = []
+    for dirpath, _dirs, files in os.walk(
+            os.path.join(ROOT, "cluster_capacity_tpu")):
+        for fn in sorted(files):
+            if fn.endswith(".py"):
+                rels.append(os.path.relpath(
+                    os.path.join(dirpath, fn), ROOT).replace(os.sep, "/"))
+    return sorted(rels)
+
+
 def _node(name: str) -> dict:
     alloc = {"cpu": f"{NODE_CPU_M}m", "memory": str(NODE_MEM),
              "pods": "500"}
@@ -160,6 +173,19 @@ class Soak:
                        max_bundles=100000, capture_ir=False)
         import threading
         self.thread_base = threading.active_count()
+        # opt-in dynamic lock witness (CC_LOCK_WITNESS=1): record runtime
+        # lock-acquisition order and assert it stays consistent with
+        # concgate's static LK001 graph at the end of the run
+        self.witness = None
+        self._witness_uninstalls = []
+        if os.environ.get("CC_LOCK_WITNESS"):
+            from tools.concgate import witness as ccwitness
+            self.witness = ccwitness.Witness()
+            self._witness_uninstalls = [
+                ccwitness.install_defaults(self.witness),
+                ccwitness.install_supervisor(self.sup, self.witness),
+            ]
+            print("soak: lock witness armed (CC_LOCK_WITNESS)")
 
     # -- one serving round --------------------------------------------------
 
@@ -523,6 +549,29 @@ class Soak:
             self.fail("churn script produced no quarantined deltas — the "
                       "validation path went unexercised")
 
+    def check_witness(self) -> None:
+        """Lock-witness verdict (CC_LOCK_WITNESS runs only): runtime
+        acquisition order must stay cycle-free against concgate's static
+        LK001 graph.  Unmodeled edges are reported, not failed — fault
+        injection drives dynamic-dispatch paths the static walk cannot
+        see."""
+        if self.witness is None:
+            return
+        for uninstall in reversed(self._witness_uninstalls):
+            uninstall()
+        from tools import concgate
+        report = concgate.analyze_files(
+            ROOT, _concgate_files(), guards_doc=concgate.load_guards())
+        static = concgate.static_edges(report)
+        for line in self.witness.violations(static):
+            self.fail(f"lock-order cycle witnessed at runtime: {line}")
+        unmodeled = self.witness.unmodeled(static)
+        for line in unmodeled:
+            print(f"soak: witness: unmodeled lock-order edge: {line}")
+        print(f"soak: witness: {len(self.witness.edges())} runtime "
+              f"edge(s), {len(unmodeled)} unmodeled, "
+              f"{len(static)} static")
+
     # -- artifact -----------------------------------------------------------
 
     def artifact(self, steady: Dict[str, float]) -> Dict[str, object]:
@@ -591,6 +640,7 @@ class Soak:
               f"steady phase")
         steady = self.steady()
         self.check_final(steady)
+        self.check_witness()
         doc = self.artifact(steady)
         with open(self.args.out, "w", encoding="utf-8") as fh:
             json.dump(doc, fh, indent=2)

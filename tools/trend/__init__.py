@@ -145,6 +145,19 @@ def collect(root: str = ROOT) -> dict:
         if doc is not None:
             gates[name] = {"clean": bool(doc.get("clean")),
                            "findings": len(doc.get("findings") or [])}
+    # concgate's artifact carries an int finding count plus the per-rule
+    # split (LK001..LK006) and the suppression tally — the concurrency
+    # debt trend, not just a verdict
+    doc = _load(os.path.join(root, "CONCGATE.json"))
+    if doc is not None:
+        entry = {"clean": bool(doc.get("clean")),
+                 "findings": int(doc.get("findings") or 0),
+                 "suppressed": int(doc.get("suppressed") or 0)}
+        by_rule = doc.get("by_rule")
+        if isinstance(by_rule, dict):
+            entry["by_rule"] = {str(k): int(v)
+                                for k, v in sorted(by_rule.items())}
+        gates["concgate"] = entry
 
     return {"rounds": sorted(rounds), "metrics": metrics, "gates": gates,
             "phases": phases}
@@ -245,6 +258,14 @@ def render_markdown(data: dict, regs: List[dict]) -> str:
         for name, g in sorted(data["gates"].items()):
             verdict = "clean" if g["clean"] else (
                 f"{g['findings']} finding(s)")
+            extras = []
+            if g.get("suppressed"):
+                extras.append(f"{g['suppressed']} suppressed with reason")
+            by_rule = g.get("by_rule") or {}
+            extras += [f"{rule}: {n}" for rule, n in sorted(
+                by_rule.items()) if n]
+            if extras:
+                verdict += " (" + ", ".join(extras) + ")"
             lines.append(f"- **{name}**: {verdict}")
     lines += ["", "## Regressions", ""]
     if regs:
