@@ -7,7 +7,7 @@ CXX ?= g++
 CXXFLAGS ?= -O2 -std=c++17 -fPIC -Wall
 NATIVE_LIB := cluster_capacity_tpu/models/libccsnap.so
 
-.PHONY: all build native lint concgate test-unit test-parity test-fuzz test-dist test-integration test-e2e bench multichip perfgate compilegate trend chaos profile-smoke soak soak-smoke clean verify-native ci
+.PHONY: all build native lint concgate shardgate gates test-unit test-parity test-fuzz test-dist test-integration test-e2e bench multichip perfgate compilegate trend chaos profile-smoke soak soak-smoke clean verify-native ci
 
 all: build
 
@@ -36,6 +36,23 @@ lint:
 # the CONCGATE.json artifact for tools/trend.
 concgate:
 	$(PY) -m tools.concgate --json-out CONCGATE.json
+
+# Static sharding & per-device memory gate (tools/shardgate): lowers every
+# sharded canonical entry under the {1x1, 2x4, 4x2, 8x1} mesh matrix on
+# the virtual 8-device CPU backend WITHOUT executing, and enforces
+# partition coverage (SP001), per-cell collective budgets (SP002,
+# tools/shardgate/budgets.json), the scale-extrapolated per-shard memory
+# model vs the pinned device HBM (SP003 — the 64k rung must be statically
+# proven to fit), padding/divisibility invariants (SP004), and the
+# host-readback audit over the drain/scan call graph (SP005).  Emits the
+# SHARDGATE.json artifact for tools/trend.
+shardgate:
+	$(PY) -m tools.shardgate --json-out SHARDGATE.json
+
+# The whole static-analysis suite in one verdict: jaxlint + irgate +
+# concgate + shardgate, merged into GATES.json for tools/trend.
+gates:
+	$(PY) tools/gates.py
 
 # Unit + behavioral suite (fake in-memory clusters; no hardware needed).
 test-unit:

@@ -316,7 +316,8 @@ def _spread_arrays(pb: enc.EncodedProblem, ch: int, dh: int, n: int):
 
 
 def bracket_device(pbs: Sequence[enc.EncodedProblem], *,
-                   mesh=None) -> List[CapacityBracket]:
+                   mesh=None,
+                   lower_only: bool = False) -> List[CapacityBracket]:
     """ONE batched device shot bracketing every problem: the fit planes (and
     any hard-spread planes, padded to group maxima) stack on a quantized
     leading axis and run through the vmapped kernel.  Problems must share
@@ -400,9 +401,24 @@ def bracket_device(pbs: Sequence[enc.EncodedProblem], *,
             mindom = mesh_lib._pad_axis(mindom, 0, bq2, 0)
             selfm = mesh_lib._pad_axis(selfm, 0, bq2, False)
         runner = _bracket_runner(c_eff, dh, mesh)
+        if lower_only:
+            # tools/shardgate trace-without-execute seam (sweep.solve_group)
+            return {"kind": "bracket", "runner": runner,
+                    "args": (free, req, pods_free, gate,
+                             dom, e, valid, skew, mindom, selfm),
+                    "consts": {"free": free, "req": req,
+                               "pods_free": pods_free, "gate": gate,
+                               "dom": dom, "e": e, "valid": valid,
+                               "skew": skew, "mindom": mindom,
+                               "selfm": selfm},
+                    "carry": None,
+                    "meta": {"n_nodes": n, "n_pad": free.shape[1],
+                             "batch": b, "b_pad": free.shape[0]}}
         lo, hi, lp = runner(free, req, pods_free, gate,
                             dom, e, valid, skew, mindom, selfm)
         lo, hi, lp = np.asarray(lo), np.asarray(hi), np.asarray(lp)
+    elif lower_only:
+        return None                      # all-sentinel batch: nothing lowers
 
     out: List[CapacityBracket] = []
     for i, pb in enumerate(pbs):
@@ -501,10 +517,12 @@ def _mix_arrays(pbs: Sequence[enc.EncodedProblem]):
 
 
 def auction_device(pbs: Sequence[enc.EncodedProblem],
-                   rounds: int = 4, *, mesh=None) -> List[int]:
+                   rounds: int = 4, *, mesh=None,
+                   lower_only: bool = False) -> List[int]:
     """K-round auction on device: per-template constructive claims against
     the SHARED free matrix (templates must encode the same snapshot).
     Dispatch-set member (GD001) — `bracket_mix` is the guarded entry."""
+    n = pbs[0].snapshot.num_nodes
     free, pods_free, reqs, gates = _mix_arrays(pbs)
     if mesh is not None:
         from ..parallel import mesh as mesh_lib
@@ -513,8 +531,17 @@ def auction_device(pbs: Sequence[enc.EncodedProblem],
         free = mesh_lib._pad_axis(free, 0, n2, 0)
         pods_free = mesh_lib._pad_axis(pods_free, 0, n2, 0)
         gates = mesh_lib._pad_axis(gates, 1, n2, False)
-    claimed = np.asarray(_auction_runner(int(rounds), mesh)(
-        free, pods_free, reqs, gates))
+    runner = _auction_runner(int(rounds), mesh)
+    if lower_only:
+        # tools/shardgate trace-without-execute seam (sweep.solve_group)
+        return {"kind": "auction", "runner": runner,
+                "args": (free, pods_free, reqs, gates),
+                "consts": {"free": free, "pods_free": pods_free,
+                           "reqs": reqs, "gates": gates},
+                "carry": None,
+                "meta": {"n_nodes": n, "n_pad": free.shape[0],
+                         "batch": len(pbs), "b_pad": len(pbs)}}
+    claimed = np.asarray(runner(free, pods_free, reqs, gates))
     return [int(c) for c in claimed]
 
 

@@ -697,7 +697,8 @@ def solve_interleaved_tensor(snapshot: ClusterSnapshot,
                              templates: Sequence[dict],
                              profile: Optional[SchedulerProfile] = None,
                              max_total: int = 0, *,
-                             mesh=None, bounds: bool = False
+                             mesh=None, bounds: bool = False,
+                             lower_only: bool = False
                              ) -> Optional[List[sim.SolveResult]]:
     """Run the interleaved study on device; None when ineligible (callers
     fall back to sweep.sweep_interleaved, the object-level parity path).
@@ -705,7 +706,11 @@ def solve_interleaved_tensor(snapshot: ClusterSnapshot,
     mesh: shard the stacked template race over a {batch, nodes} device mesh
     (module docstring); bounds: bracket the mix first and right-size the
     scan budget / skip statically-impossible templates.  Both preserve
-    bit-identity with the unsharded, unbounded run."""
+    bit-identity with the unsharded, unbounded run.
+
+    lower_only: encode/pad/shard exactly as a real run, then return the
+    assembled chunk runner + concrete args instead of dispatching (the
+    tools/shardgate trace-without-execute seam; see sweep.solve_group)."""
     import jax
     import jax.numpy as jnp
 
@@ -1034,6 +1039,18 @@ def solve_interleaved_tensor(snapshot: ClusterSnapshot,
     run = _xchunk_runner() if mesh is None else \
         _xchunk_runner_sharded(mesh, sconsts, xconsts, needs_tpl)
     placements: List[List[int]] = [[] for _ in pbs]
+
+    if lower_only:
+        # Static-analysis escape hatch (tools/shardgate): the race is fully
+        # encoded, padded, and sharded, the production chunk runner exists —
+        # return it with the exact arguments the main loop would dispatch,
+        # without popping a single template.
+        return {"kind": "interleave", "runner": run,
+                "args": (cfg, sconsts, xconsts, xc, CHUNK),
+                "consts": {**sconsts, **xconsts}, "carry": xc,
+                "meta": {"n_nodes": n, "n_pad": n_pad,
+                         "batch": t_n, "b_pad": t_pad, "chunk": CHUNK,
+                         "needs_tpl": needs_tpl}}
 
     if skip.any():
         # precompute the skipped templates' diagnoses at the initial state

@@ -89,6 +89,57 @@ def mesh_shape(mesh) -> Optional[Dict[str, int]]:
             for a, s in zip(mesh.axis_names, mesh.devices.shape)}
 
 
+# Consts classification: which sharding family each build_consts key
+# belongs to.  Public single source shared by consts_shardings below and
+# tools/shardgate's SP001 partition-coverage rule — a key missing from
+# every set falls through to the replicate branch SILENTLY, which is
+# exactly the hazard shardgate exists to name, so the classification must
+# be inspectable from outside this module.
+NODE_MAT = frozenset({"allocatable"})
+NODE_VEC = frozenset({"static_mask", "volume_mask", "taint_raw", "na_raw",
+                      "il_score", "ss_ignored", "ipa_eanti_static",
+                      "ipa_static_pref", "sh_missing"})
+CONS_BY_NODE = frozenset({"sh_dom", "sh_countable", "sh_cnt_init",
+                          "ss_dom", "ss_countable", "ss_cnt_init",
+                          "ss_node_existing", "ipa_dom",
+                          "ipa_aff_scnt", "ipa_anti_scnt"})
+# Keys that carry no node axis and are DELIBERATELY replicated (tiny
+# per-template vectors/scalars the step reads whole).  Kept explicit so
+# the replicate fallback in consts_shardings only ever serves keys a
+# reviewer has looked at; shardgate flags anything outside all five sets.
+REPLICATED_OK = frozenset({
+    # per-resource request vectors / weights
+    "req_vec", "shared_req_vec", "req_nonzero", "fit_w", "fit_req",
+    "bal_req",
+    # per-constraint scalars/vectors (C is small; the step reads them whole)
+    "sh_skew", "sh_mindom", "sh_domnum", "sh_self",
+    "ss_skew", "ss_self", "ss_host",
+    # per-group IPA statics
+    "ipa_ghas_aff", "ipa_ghas_anti", "ipa_aff_ginc", "ipa_anti_ginc",
+    "ipa_pref_gw",
+    # per-template self-conflict gate scalars
+    "vol_self_gate", "rwop_gate", "dra_colo_gate",
+})
+
+
+def classify_const(key: str) -> Optional[str]:
+    """Sharding family of a consts key: 'node_mat' | 'node_vec' |
+    'cons_by_node' | 'ss_onehot' | 'replicated' | None.  None means the
+    key is UNCLASSIFIED and consts_shardings will replicate it by
+    fallback — tools/shardgate SP001 names those."""
+    if key in NODE_MAT:
+        return "node_mat"
+    if key in NODE_VEC:
+        return "node_vec"
+    if key in CONS_BY_NODE:
+        return "cons_by_node"
+    if key == "ss_onehot":
+        return "ss_onehot"
+    if key in REPLICATED_OK:
+        return "replicated"
+    return None
+
+
 def consts_shardings(mesh, consts: Dict[str, "jax.Array"],
                      batched: bool = False) -> Dict[str, "jax.sharding.NamedSharding"]:
     """NamedSharding per consts entry (see build_consts in engine/simulator)."""
@@ -99,22 +150,14 @@ def consts_shardings(mesh, consts: Dict[str, "jax.Array"],
             return NamedSharding(mesh, P(BATCH_AXIS, *parts))
         return NamedSharding(mesh, P(*parts))
 
-    node_mat = {"allocatable"}
-    node_vec = {"static_mask", "volume_mask", "taint_raw", "na_raw",
-                "il_score", "ss_ignored", "ipa_eanti_static",
-                "ipa_static_pref", "sh_missing"}
-    cons_by_node = {"sh_dom", "sh_countable", "sh_cnt_init",
-                    "ss_dom", "ss_countable", "ss_cnt_init",
-                    "ss_node_existing", "ipa_dom",
-                    "ipa_aff_scnt", "ipa_anti_scnt"}
     out = {}
     for k, v in consts.items():
         rank = v.ndim - (1 if batched else 0)   # per-problem rank
-        if k in node_mat:
+        if k in NODE_MAT:
             out[k] = spec(NODE_AXIS, None)
-        elif k in node_vec:
+        elif k in NODE_VEC:
             out[k] = spec(NODE_AXIS)
-        elif k in cons_by_node:
+        elif k in CONS_BY_NODE:
             out[k] = spec(None, NODE_AXIS)
         elif k == "ss_onehot":
             out[k] = spec(None, None, NODE_AXIS)

@@ -373,7 +373,8 @@ def _group_uniform(arrs: List[np.ndarray]) -> bool:
 
 def solve_group(pbs: List[enc.EncodedProblem], max_limit: int = 0,
                 mesh=None, explain: bool = False,
-                bounds: bool = True) -> List[sim.SolveResult]:
+                bounds: bool = True,
+                lower_only: bool = False) -> List[sim.SolveResult]:
     """Public batched-group entry for pre-encoded problems.
 
     The resilience analyzer (resilience/analyzer.py) encodes one problem per
@@ -387,14 +388,25 @@ def solve_group(pbs: List[enc.EncodedProblem], max_limit: int = 0,
     its slice of the batched terminal carry (per-template reason codes +
     bottleneck).  Why-here attribution is a per-template product — callers
     wanting it route through the per-template ladder (sweep(explain=True)
-    does exactly that)."""
+    does exactly that).
+
+    `lower_only=True` stops at the traceable boundary: the group is encoded,
+    padded, and sharded exactly as a real solve would be, but instead of
+    dispatching, the assembled chunk runner and its concrete arguments are
+    returned (see _batched_solve) so static analyzers (tools/shardgate) can
+    trace/lower the production computation without executing it."""
+    # lower_only is forwarded only when set: callers (and tests) wrap
+    # _batched_solve with the pre-seam signature, and the solve path must
+    # keep calling it exactly as before.
+    kw = {"lower_only": True} if lower_only else {}
     return _batched_solve(list(pbs), max_limit, mesh=mesh, explain=explain,
-                          bounds=bounds)
+                          bounds=bounds, **kw)
 
 
 def _batched_solve(pbs: List[enc.EncodedProblem], max_limit: int,
                    mesh=None, explain: bool = False,
-                   bounds: bool = True) -> List[sim.SolveResult]:
+                   bounds: bool = True,
+                   lower_only: bool = False) -> List[sim.SolveResult]:
     import jax
     import jax.numpy as jnp
 
@@ -470,6 +482,24 @@ def _batched_solve(pbs: List[enc.EncodedProblem], max_limit: int,
         run_chunk = _batched_chunk_runner_sharded(mesh, consts, carry)
     else:
         run_chunk = _batched_chunk_runner()
+
+    if lower_only:
+        # Static-analysis escape hatch (tools/shardgate): hand back the
+        # production runner + the exact concrete arguments a real solve
+        # would dispatch, WITHOUT running a step.  The chunk quantization
+        # below is duplicated so the static arg matches the real call.
+        chunk = min(1024, budget)
+        if chunk > 1:
+            chunk = 1 << (chunk - 1).bit_length()
+        b_pad, n_pad = carry.placed.shape
+        return {"kind": "sweep", "runner": run_chunk,
+                "args": (cfg, consts, carry, chunk),
+                "consts": stacked if mesh is not None else {**shared,
+                                                            **stacked},
+                "carry": carry,
+                "meta": {"n_nodes": n_nodes, "n_pad": int(n_pad),
+                         "batch": len(pbs), "b_pad": int(b_pad),
+                         "chunk": chunk}}
 
     # The batched fused kernel runs whole chunks for the whole group in one
     # Pallas call (grid over templates, per-template scalars from SMEM) when

@@ -248,3 +248,51 @@ def test_trend_tool(tmp_path):
     assert data["metrics"]["multichip_ok"] == {1: 1.0}
     regs = regressions(data)
     assert len(regs) == 1 and regs[0]["drop_pct"] == 20.0
+
+
+def test_trend_standing_regression_slow_bleed(tmp_path):
+    """A metric bleeding <10% per round but >20% cumulatively must surface
+    as a STANDING regression (best-ever round named), while the
+    round-over-round check stays silent."""
+    from tools.trend import collect, regressions, standing_regressions
+    root = str(tmp_path)
+    for n, pps in ((1, 1000.0), (2, 930.0), (3, 870.0), (4, 790.0)):
+        with open(os.path.join(root, f"BENCH_r{n:02d}.json"), "w") as f:
+            json.dump({"parsed": {"metric": "bleed_per_sec", "value": pps,
+                                  "unit": "placements/s"}}, f)
+    data = collect(root)
+    assert regressions(data) == []          # every step under 10%
+    standing = standing_regressions(data)
+    assert len(standing) == 1
+    s = standing[0]
+    assert s["metric"] == "bleed_per_sec"
+    assert s["best_round"] == 1 and s["round"] == 4
+    assert s["drift_pct"] == 21.0
+    # recovery clears it: a new best means no standing drift
+    with open(os.path.join(root, "BENCH_r05.json"), "w") as f:
+        json.dump({"parsed": {"metric": "bleed_per_sec", "value": 1010.0,
+                              "unit": "placements/s"}}, f)
+    assert standing_regressions(collect(root)) == []
+
+
+def test_trend_ingests_shardgate_and_merged_gates(tmp_path):
+    """SHARDGATE.json contributes the frontier fit verdicts; GATES.json
+    backfills gates whose own artifact was not committed."""
+    from tools.trend import collect
+    root = str(tmp_path)
+    with open(os.path.join(root, "SHARDGATE.json"), "w") as f:
+        json.dump({"clean": True, "findings": [], "verdicts": {
+            "sharded_group": {"65536": {"fits": True},
+                              "100000": {"fits": False}}}}, f)
+    with open(os.path.join(root, "GATES.json"), "w") as f:
+        json.dump({"gates_suite": 1, "clean": False, "gates": {
+            "jaxlint": {"clean": False, "findings": 2, "suppressed": 1},
+            "shardgate": {"clean": False, "findings": 9}}}, f)
+    gates = collect(root)["gates"]
+    # the dedicated artifact wins over the merged doc
+    assert gates["shardgate"]["clean"] and gates["shardgate"][
+        "findings"] == 0
+    assert gates["shardgate"]["fits_64k"] == {"sharded_group": True}
+    assert gates["shardgate"]["fits_100k"] == {"sharded_group": False}
+    assert gates["jaxlint"] == {"clean": False, "findings": 2,
+                                "suppressed": 1}

@@ -1,0 +1,122 @@
+"""Mesh-padding edge cases: the corners of pad_for_mesh and the template
+quantizer where the pad region dominates the real data — non-pow2 template
+counts, more batch shards than batch rows, and single-row (or pure-pad)
+node shards — every one pinned bit-identical to the unsharded solve.
+
+These lanes are exactly what shardgate's SP004 verifies statically from
+the lowered shapes; here the same invariants are proven dynamically."""
+
+import jax
+import numpy as np
+import pytest
+
+from test_interleave_tensor import _assert_same, _nodes, _template
+from test_multichip import _masked_problems, _probe, _random_masks, _snapshot
+
+from cluster_capacity_tpu.models.snapshot import ClusterSnapshot
+from cluster_capacity_tpu.parallel import interleave as il
+from cluster_capacity_tpu.parallel import mesh as mesh_lib
+from cluster_capacity_tpu.parallel.interleave import _quantize_templates
+from cluster_capacity_tpu.parallel.sweep import solve_group
+from cluster_capacity_tpu.utils.config import SchedulerProfile
+
+needs_8 = pytest.mark.skipif(len(jax.devices()) < 8,
+                             reason="needs 8 virtual devices")
+
+
+def test_quantize_templates_pow2_pin():
+    """No mesh: next power of two, 1 stays 1."""
+    assert [_quantize_templates(t, None) for t in (1, 2, 3, 5, 6, 7, 9)] \
+        == [1, 2, 4, 8, 8, 8, 16]
+
+
+def test_quantize_templates_shard_multiple():
+    """With a mesh the pow2 target rounds UP to the batch-shard multiple —
+    including when the shard count exceeds the template count."""
+    m82 = mesh_lib.make_mesh(n_node_shards=1, n_batch_shards=8)
+    assert _quantize_templates(3, m82) == 8     # pow2 4, then x8 multiple
+    assert _quantize_templates(1, m82) == 8     # 1 template, 8 shards
+    m24 = mesh_lib.make_mesh(n_node_shards=4, n_batch_shards=2)
+    assert _quantize_templates(5, m24) == 8     # already a multiple of 2
+
+
+def test_pad_rows_are_inert_by_construction():
+    """pad_for_mesh's node rows must carry the inert fills SP004 checks:
+    domain maps -1, missing/ignored flags 1, everything else 0 — and the
+    batch rows must duplicate the last template."""
+    from cluster_capacity_tpu.engine import encode as enc
+    snap = _snapshot(13, seed=5)
+    profile = SchedulerProfile.parity()
+    pbs = [enc.encode_problem(snap, _probe(name=f"p{i}"), profile)
+           for i in range(3)]
+    seam = solve_group(pbs, max_limit=8,
+                       mesh=mesh_lib.make_mesh(n_node_shards=4,
+                                               n_batch_shards=2),
+                       lower_only=True)
+    n, n_pad = seam["meta"]["n_nodes"], seam["meta"]["n_pad"]
+    b, b_pad = seam["meta"]["batch"], seam["meta"]["b_pad"]
+    assert (n, n_pad, b, b_pad) == (13, 16, 3, 4)
+    for key, v in seam["consts"].items():
+        a = np.asarray(v)
+        ax = mesh_lib._NODE_AXIS_OF.get(key)
+        if ax is None or ax + 1 >= a.ndim:
+            continue
+        want = -1 if key in mesh_lib._PAD_NEG else \
+            (1 if key in mesh_lib._PAD_ONE else 0)
+        region = np.take(a, range(n, n_pad), axis=ax + 1)
+        assert np.all(region == want), key
+        # batch rows duplicate the last real problem
+        assert np.array_equal(np.take(a, [b - 1], 0), np.take(a, [b], 0)), key
+
+
+@needs_8
+def test_more_batch_shards_than_problems():
+    """An 8-way batch mesh over 3 problems: 5 of 8 shard rows are pure
+    duplicate padding, and the results must still be bit-identical."""
+    snap = _snapshot(13, seed=1)
+    probe = _probe()
+    mesh = mesh_lib.make_mesh(n_node_shards=1, n_batch_shards=8)
+    masks = _random_masks(np.random.RandomState(3), 13, count=3)
+    plain = solve_group(_masked_problems(snap, probe, masks), max_limit=16)
+    shard = solve_group(_masked_problems(snap, probe, masks), max_limit=16,
+                        mesh=mesh)
+    for a, b in zip(plain, shard):
+        assert a.placements == b.placements
+        assert a.fail_type == b.fail_type
+        assert a.fail_message == b.fail_message
+
+
+@needs_8
+@pytest.mark.parametrize("n_nodes", [8, 9])
+def test_single_row_node_shards(n_nodes):
+    """An 8-way node mesh where each shard holds ONE real row (n=8) or
+    where most shards hold a single row plus pure pad (n=9 -> n_pad=16):
+    the inert rows must be behaviorally invisible."""
+    snap = _snapshot(n_nodes, seed=n_nodes)
+    probe = _probe(spread=True)
+    mesh = mesh_lib.make_mesh(n_node_shards=8, n_batch_shards=1)
+    masks = _random_masks(np.random.RandomState(n_nodes), n_nodes, count=2)
+    for bounds in (False, True):
+        plain = solve_group(_masked_problems(snap, probe, masks),
+                            max_limit=12, bounds=bounds)
+        shard = solve_group(_masked_problems(snap, probe, masks),
+                            max_limit=12, mesh=mesh, bounds=bounds)
+        for a, b in zip(plain, shard):
+            assert a.placements == b.placements, (n_nodes, bounds)
+            assert a.fail_type == b.fail_type, (n_nodes, bounds)
+
+
+@needs_8
+@pytest.mark.parametrize("t_n", [1, 5])
+def test_interleave_nonpow2_templates_parity(t_n):
+    """Template counts that quantize up hard (1 -> 8 pad rows on an 8-way
+    batch mesh, 5 -> 8) must leave the interleaved race bit-identical to
+    the unsharded reference."""
+    prof = SchedulerProfile.parity()
+    snap = ClusterSnapshot.from_objects(_nodes(11, seed=t_n))
+    ts = [_template(f"t{i}", 300 + 150 * i, mem_gi=i % 2,
+                    labels={"app": f"t{i}"}) for i in range(t_n)]
+    mesh = mesh_lib.make_mesh(n_node_shards=1, n_batch_shards=8)
+    ref = il.solve_interleaved_tensor(snap, ts, prof)
+    got = il.solve_interleaved_tensor(snap, ts, prof, mesh=mesh)
+    _assert_same(ref, got, f"t_n={t_n}")

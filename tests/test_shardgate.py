@@ -1,0 +1,322 @@
+"""shardgate: the static sharding & per-device memory gate.
+
+Covers the shared collective classifier (including the IC007 semantics
+pin), the scale-substituted memory model, the budget ratchet, the SP005
+readback walk against the committed allowlist, and the three seeded
+regressions the issue demands — a replicated large const (SP001), an
+injected all-gather (SP002), and an HBM pin too small for the 64k rung
+(SP003) — each failing with the entry, mesh, and rule named.
+
+The full-matrix run goes through a subprocess because conftest.py enables
+jax_enable_x64 process-wide and the committed collective pins assume the
+CLI's canonical x64-off 8-device CPU environment.  The in-process seeded
+cells only assert finding PRESENCE, which x64 does not change."""
+
+import json
+import os
+import re
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from cluster_capacity_tpu.parallel import mesh as mesh_lib
+from tools.shardgate import Finding, budgets as budgets_mod, collectives
+from tools.shardgate import comms, memory, partition, readback
+from tools.shardgate.lowering import Cell
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+P = jax.sharding.PartitionSpec
+
+
+def _ns(mesh, *spec):
+    return jax.sharding.NamedSharding(mesh, P(*spec))
+
+
+# ---------------------------------------------------------------------------
+# collective classifier (shared with irgate IC007)
+# ---------------------------------------------------------------------------
+
+def test_classify_primitive():
+    assert collectives.classify_primitive("all_gather") == "all_gather"
+    assert collectives.classify_primitive("all_gather_invariant") == \
+        "all_gather"
+    assert collectives.classify_primitive("all_to_all") == "all_to_all"
+    assert collectives.classify_primitive("psum") == "all_reduce"
+    assert collectives.classify_primitive("psum_scatter") == "reduce_scatter"
+    assert collectives.classify_primitive("ppermute") == "collective_permute"
+    assert collectives.classify_primitive("dot_general") is None
+    assert collectives.classify_primitive("gather") is None
+
+
+def test_hlo_counts_op_applications():
+    text = """
+      %all-reduce.1 = f32[8]{0} all-reduce(%x), replica_groups={}
+      %ag = f32[16]{0} all-gather(%y), dimensions={0}
+      %ag2 = (f32[16], u32[]) all-gather-start(%y)
+      ROOT %t = tuple(%all-reduce.1)  // mentions all-reduce but no apply
+    """
+    counts = collectives.hlo_counts(text)
+    assert counts["all_reduce"] == 1
+    assert counts["all_gather"] == 2          # plain + async start
+    assert "reduce_scatter" not in counts
+
+
+def test_hlo_counts_stablehlo_and_custom_calls():
+    text = """
+      %0 = stablehlo.custom_call @Sharding(%arg0)
+      %1 = stablehlo.custom_call @SPMDFullToShardShape(%0)
+      %2 = "stablehlo.all_reduce"(%1) ({ ... })
+    """
+    counts = collectives.hlo_counts(text)
+    assert counts[collectives.CUSTOM_CALL_KIND] == 2
+    assert counts["all_reduce"] == 1
+
+
+def test_ic007_hlo_semantics_pinned():
+    """hlo_contains(GATHER_KINDS) must agree with the original IC007 regex
+    on every spelling either could meet."""
+    old = re.compile(r"\ball[-_]gather\b|\ball[-_]to[-_]all\b")
+    corpus = [
+        "x = all-gather(y)", "stablehlo.all_gather", "all_to_all(z)",
+        "all-to-all-start(z)", "small_gather(y)", "tall-gather",
+        "psum(x)", "reduce-scatter(x)", "collective-permute(x)", "",
+    ]
+    for text in corpus:
+        assert (collectives.hlo_contains(text, collectives.GATHER_KINDS)
+                == bool(old.search(text))), text
+
+
+def test_ic007_jaxpr_semantics_pinned():
+    """classify_primitive ∈ GATHER_KINDS must agree with the original
+    substring check on primitive names."""
+    markers = ("all_gather", "all_to_all")
+    for name in ("all_gather", "all_gather_invariant", "all_to_all",
+                 "psum", "psum_scatter", "gather", "dynamic_slice"):
+        assert ((collectives.classify_primitive(name)
+                 in collectives.GATHER_KINDS)
+                == any(m in name for m in markers)), name
+
+
+# ---------------------------------------------------------------------------
+# memory model units
+# ---------------------------------------------------------------------------
+
+def test_shape_bytes_at_scale_shards_node_axis():
+    # n_pad=16 under 4 node shards, scaled to 64k: per-shard 16384 rows
+    b = memory.shape_bytes_at_scale((16, 8), 4, n_pad=16, b_pad=1,
+                                    shards=(2, 4), scale=65536)
+    assert b == (65536 // 4) * 8 * 4
+    # replicated pricing keeps the full padded extent
+    full = memory.shape_bytes_at_scale((16, 8), 4, n_pad=16, b_pad=1,
+                                       shards=(2, 4), scale=65536,
+                                       per_shard=False)
+    assert full == 65536 * 8 * 4
+
+
+def test_shape_bytes_at_scale_batch_axis():
+    b = memory.shape_bytes_at_scale((4, 16), 4, n_pad=16, b_pad=4,
+                                    shards=(2, 4), scale=65536)
+    assert b == 2 * (65536 // 4) * 4          # batch dim halves too
+
+
+def test_collision_check_flags_ambiguous_anchors():
+    cell = type("C", (), {"entry": "x", "mesh_name": "2x4",
+                          "meta": {"n_pad": 8, "b_pad": 8, "chunk": 128}})()
+    bad = memory.collision_check(cell)
+    assert bad is not None and bad.rule == "SP000"
+
+
+# ---------------------------------------------------------------------------
+# budget ratchet
+# ---------------------------------------------------------------------------
+
+def test_ratchet_new_cell_seeds_freely():
+    assert budgets_mod.loosenings({}, {"e|2x4": {"all_gather": 3}}) == []
+
+
+def test_ratchet_refuses_raised_ceiling(tmp_path):
+    old = {"e|2x4": {"all_gather": 2}}
+    worse = budgets_mod.loosenings(old, {"e|2x4": {"all_gather": 3}})
+    assert worse == ["e|2x4 all_gather: 2 -> 3"]
+    doc = {"collectives": old}
+    path = str(tmp_path / "b.json")
+    wrote, _ = budgets_mod.update(doc, {"e|2x4": {"all_gather": 3}},
+                                  allow_looser=False, path=path)
+    assert not wrote and not os.path.exists(path)
+    wrote, _ = budgets_mod.update(doc, {"e|2x4": {"all_gather": 3}},
+                                  allow_looser=True, path=path)
+    assert wrote
+    assert json.load(open(path))["collectives"]["e|2x4"]["all_gather"] == 3
+
+
+def test_ratchet_allows_tightening(tmp_path):
+    doc = {"collectives": {"e|2x4": {"all_gather": 5}}}
+    path = str(tmp_path / "b.json")
+    wrote, worse = budgets_mod.update(doc, {"e|2x4": {"all_gather": 1}},
+                                      allow_looser=False, path=path)
+    assert wrote and worse == []
+
+
+# ---------------------------------------------------------------------------
+# seeded regressions (in-process synthetic cells)
+# ---------------------------------------------------------------------------
+
+N_PAD = 16
+
+
+def _seeded_cell(entry, fn, args, mesh_name="2x4", consts=None):
+    mesh = mesh_lib.parse_mesh(mesh_name)
+    seam = {"kind": "bracket", "runner": fn, "args": args,
+            "consts": consts or {}, "carry": None,
+            "meta": {"n_nodes": 13, "n_pad": N_PAD, "batch": 1, "b_pad": 1}}
+    return Cell(entry, mesh_name, mesh, seam)
+
+
+def test_seeded_replicated_const_fails_sp001():
+    """A large node-shaped const left fully replicated must be named."""
+    mesh = mesh_lib.parse_mesh("2x4")
+    big = jnp.zeros((N_PAD, 512), jnp.float32)
+    x = jnp.zeros((N_PAD,), jnp.float32)
+    fn = jax.jit(lambda b, v: (b * v[:, None]).sum(),
+                 in_shardings=(_ns(mesh, None, None),
+                               _ns(mesh, mesh_lib.NODE_AXIS)))
+    cell = _seeded_cell("seeded_repl", fn, (big, x))
+    budgets = {"replicated_bytes_threshold": 1 << 20, "replicated_ok": {}}
+    found = partition.check_partition(cell, budgets)
+    assert any(f.rule == "SP001" and f.entry == "seeded_repl"
+               and f.mesh == "2x4" and "replicated" in f.message
+               for f in found), found
+    # the allowlist silences exactly that leaf, by name
+    key = next(f for f in found if f.rule == "SP001").message
+    path = key.split("allowlist '")[1].split("'")[0]
+    budgets["replicated_ok"] = {path: "test"}
+    assert partition.check_partition(cell, budgets) == []
+
+
+def test_seeded_allgather_fails_sp002():
+    """An injected gather (sharded in, replicated out) must exceed the
+    pinned budget of zero and be named with its op and mesh."""
+    mesh = mesh_lib.parse_mesh("2x4")
+    x = jnp.zeros((N_PAD,), jnp.float32)
+    fn = jax.jit(lambda v: v * 2.0,
+                 in_shardings=_ns(mesh, mesh_lib.NODE_AXIS),
+                 out_shardings=_ns(mesh))
+    cell = _seeded_cell("seeded_gather", fn, (x,))
+    table = {}
+    found = comms.check_comms(
+        [cell], {"collectives": {"seeded_gather|2x4": {}}}, table)
+    assert any(f.rule == "SP002" and f.entry == "seeded_gather"
+               and f.mesh == "2x4" and "all_gather" in f.message
+               for f in found), (found, table)
+
+
+def test_seeded_tiny_hbm_fails_sp003():
+    """With the HBM pin forced tiny, the 64k extrapolation must fail with
+    the shortfall percentage named."""
+    mesh = mesh_lib.parse_mesh("2x4")
+    big = jnp.zeros((N_PAD, 512), jnp.float32)
+    fn = jax.jit(lambda b: b.sum(),
+                 in_shardings=_ns(mesh, mesh_lib.NODE_AXIS, None))
+    cell = _seeded_cell("seeded_hbm", fn, (big,))
+    table = {}
+    found = memory.check_memory([cell], {"device_hbm_bytes": 1024}, table)
+    f = next(f for f in found if f.rule == "SP003")
+    assert f.entry == "seeded_hbm" and f.mesh == "2x4" and f.scale == 65536
+    assert "does not fit" in f.message and "%" in f.message
+    # and the table records the extrapolation that failed
+    assert table["seeded_hbm|2x4"][65536] > 1024
+
+
+# ---------------------------------------------------------------------------
+# SP005 readback walk (pure AST — no jax work)
+# ---------------------------------------------------------------------------
+
+def test_readback_clean_under_committed_allowlist():
+    doc = budgets_mod.load()
+    assert doc is not None
+    assert readback.check_readbacks(REPO, doc) == []
+
+
+def test_readback_trips_without_allowlist():
+    found = readback.check_readbacks(REPO, {"readback_ok": {}})
+    assert found, "the designed sync points must be visible to the walk"
+    assert all(f.rule == "SP005" for f in found)
+    # chains render root -> ... -> site, and the sweep's designed per-chunk
+    # pull is among them
+    assert any("parallel.sweep._batched_solve:asarray" in f.message
+               for f in found)
+    assert all(" -> " in f.message or "reachable via" in f.message
+               for f in found)
+
+
+def test_readback_never_enters_host_refuges():
+    found = readback.check_readbacks(REPO, {"readback_ok": {}})
+    for f in found:
+        assert "encode.py" not in f.message.split("reachable via")[0]
+        assert "fast_path.py" not in f.message.split("reachable via")[0]
+
+
+# ---------------------------------------------------------------------------
+# full matrix through the CLI (canonical x64-off environment)
+# ---------------------------------------------------------------------------
+
+def _run_gate(*extra, timeout=600):
+    env = dict(os.environ)
+    for k in ("CC_TPU_FUSED", "CC_INJECT_FAULT", "JAX_ENABLE_X64"):
+        env.pop(k, None)
+    return subprocess.run(
+        [sys.executable, "-m", "tools.shardgate", *extra],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=timeout)
+
+
+@pytest.fixture(scope="module")
+def gate(tmp_path_factory):
+    out = tmp_path_factory.mktemp("shardgate") / "report.json"
+    proc = _run_gate("--json-out", str(out))
+    doc = json.loads(out.read_text()) if out.exists() else None
+    return proc, doc
+
+
+def test_gate_clean_on_tree(gate):
+    proc, doc = gate
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert doc is not None and doc["clean"] and doc["findings"] == []
+
+
+def test_gate_covers_full_matrix(gate):
+    _, doc = gate
+    from tools.shardgate import MESH_MATRIX
+    from tools.shardgate.entries import ENTRIES
+    lanes = ("ctl",) + MESH_MATRIX
+    assert set(doc["cells"]) == {f"{e}|{m}" for e in ENTRIES for m in lanes}
+
+
+def test_gate_proves_64k_fits(gate):
+    """The ISSUE's frontier demand: every entry statically proven to fit
+    the 64k rung on some mesh lane, and a recorded 100k verdict."""
+    _, doc = gate
+    for entry, v in doc["verdicts"].items():
+        assert v["65536"]["fits"], (entry, v)
+        assert set(v["100000"]) >= {"best_mesh", "fits", "shortfall_bytes"}
+
+
+def test_gate_memory_monotone_in_scale(gate):
+    _, doc = gate
+    for name, row in doc["memory"].items():
+        assert row["100000"] >= row["65536"] >= row["2048"] > 0, name
+
+
+def test_cli_seeded_hbm_regression(tmp_path):
+    """The --fixture BUDGETS override must drive the real auction cell over
+    a tiny HBM pin and fail by name."""
+    fx = tmp_path / "fixture.py"
+    fx.write_text("def make_cells():\n    return []\n"
+                  "BUDGETS = {'device_hbm_bytes': 1000}\n")
+    proc = _run_gate("--fixture", str(fx), "--only", "bounds_auction",
+                     "--meshes", "2x4")
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "SP003" in proc.stdout and "bounds_auction" in proc.stdout
+    assert "does not fit" in proc.stdout

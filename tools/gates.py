@@ -1,0 +1,103 @@
+"""`make gates` — run the whole static-analysis suite, merge one verdict.
+
+Runs jaxlint, irgate, concgate, and shardgate as subprocesses (each in its
+own canonical environment — shardgate in particular forces the 8-device
+x64-off CPU backend before jax imports, which an in-process run could not
+undo) and merges their results into GATES.json:
+
+    {"gates_suite": 1, "clean": bool,
+     "gates": {name: {"clean", "findings", "suppressed", "rc",
+                      "elapsed_s"}}}
+
+tools/trend ingests the merged doc, so the per-gate debt trend survives
+even when an individual --json-out artifact was not committed.  Exit 0
+only when every gate is clean; a failure prints each dirty gate's tail so
+the CI log names the culprit without re-running anything.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# (name, argv, artifact written by the gate itself — None when the gate
+# has no JSON output and the summary line is parsed instead)
+GATES = (
+    ("jaxlint", ["-m", "tools.jaxlint"], None),
+    ("irgate", ["-m", "tools.irgate", "--json-out", "IRGATE.json"],
+     "IRGATE.json"),
+    ("concgate", ["-m", "tools.concgate", "--json-out", "CONCGATE.json"],
+     "CONCGATE.json"),
+    ("shardgate", ["-m", "tools.shardgate", "--json-out", "SHARDGATE.json"],
+     "SHARDGATE.json"),
+)
+
+_NEW_RE = re.compile(r"(\d+) new")
+_SUPP_RE = re.compile(r"(\d+) suppressed")
+
+
+def _findings_of(artifact: str, stdout: str) -> tuple:
+    """(findings, suppressed) from the gate's artifact, falling back to
+    its summary line."""
+    if artifact:
+        try:
+            with open(os.path.join(REPO, artifact), encoding="utf-8") as fh:
+                doc = json.load(fh)
+            raw = doc.get("findings")
+            findings = len(raw) if isinstance(raw, list) else int(raw or 0)
+            return findings, int(doc.get("suppressed") or 0)
+        except (OSError, ValueError):
+            pass
+    m_new = _NEW_RE.search(stdout)
+    m_sup = _SUPP_RE.search(stdout)
+    return (int(m_new.group(1)) if m_new else 0,
+            int(m_sup.group(1)) if m_sup else 0)
+
+
+def main(argv=None) -> int:
+    merged = {}
+    tails = []
+    for name, args, artifact in GATES:
+        t0 = time.time()
+        proc = subprocess.run([sys.executable] + args, cwd=REPO,
+                              capture_output=True, text=True, timeout=900)
+        findings, suppressed = _findings_of(artifact, proc.stdout)
+        merged[name] = {
+            "clean": proc.returncode == 0,
+            "findings": findings,
+            "suppressed": suppressed,
+            "rc": proc.returncode,
+            "elapsed_s": round(time.time() - t0, 1),
+        }
+        state = "clean" if proc.returncode == 0 else \
+            f"FAILED (rc={proc.returncode}, {findings} finding(s))"
+        print(f"gates: {name}: {state} in {merged[name]['elapsed_s']}s")
+        if proc.returncode != 0:
+            tail = "\n".join((proc.stdout + proc.stderr).splitlines()[-15:])
+            tails.append(f"--- {name} ---\n{tail}")
+
+    doc = {"gates_suite": 1,
+           "clean": all(g["clean"] for g in merged.values()),
+           "gates": merged}
+    out = os.path.join(REPO, "GATES.json")
+    with open(out, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+    for tail in tails:
+        print(tail)
+    dirty = [n for n, g in merged.items() if not g["clean"]]
+    print(f"gates: {len(merged)} gate(s), "
+          f"{'all clean' if not dirty else 'dirty: ' + ', '.join(dirty)} "
+          f"-> GATES.json")
+    return 1 if dirty else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -40,6 +40,10 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 
 from . import costs
+# IC007 classifies against the suite-wide collective table; GATHER_KINDS
+# pins the rule to its original all_gather/all_to_all scope.
+from ..shardgate.collectives import (GATHER_KINDS, classify_primitive,
+                                     hlo_contains)
 
 RULES: Dict[str, str] = {
     "IC001": "host callback primitive in lowered program",
@@ -52,8 +56,6 @@ RULES: Dict[str, str] = {
 }
 
 _CALLBACK_MARKERS = ("callback", "infeed", "outfeed", "outside_call")
-_GATHER_MARKERS = ("all_gather", "all_to_all")
-_HLO_ALL_GATHER_RE = re.compile(r"\ball[-_]gather\b|\ball[-_]to[-_]all\b")
 _HLO_CALLBACK_RE = re.compile(
     r'custom_call[^\n]*call_target_name\s*=\s*"[^"]*callback[^"]*"')
 _HLO_F64_RE = re.compile(r"\btensor<(?:\d+x)*f64>|\bf64\b")
@@ -116,7 +118,7 @@ def _check_jaxpr(entry: str, comp: str, closed_jaxpr,
                 f"host callback primitive `{name}` in lowered program"))
         if name == "while":
             while_count += 1
-        if policy.forbid_gather and any(m in name for m in _GATHER_MARKERS):
+        if policy.forbid_gather and classify_primitive(name) in GATHER_KINDS:
             findings.append(IrFinding(
                 entry, comp, "IC007",
                 f"collective `{name}` replicates a sharded table across the "
@@ -197,7 +199,7 @@ def _check_stablehlo(entry: str, comp: str, hlo_text: str,
         findings.append(IrFinding(
             entry, comp, "IC002",
             "StableHLO module contains f64-typed values"))
-    if policy.forbid_gather and _HLO_ALL_GATHER_RE.search(hlo_text):
+    if policy.forbid_gather and hlo_contains(hlo_text, GATHER_KINDS):
         findings.append(IrFinding(
             entry, comp, "IC007",
             "StableHLO module contains an all-gather/all-to-all collective "

@@ -125,8 +125,14 @@ def estimate_flops(closed_jaxpr) -> int:
     return int(_jaxpr_flops(closed_jaxpr.jaxpr))
 
 
-def peak_live_bytes(closed_jaxpr) -> int:
-    """Peak bytes simultaneously live across the top-level equation list."""
+def peak_live_bytes(closed_jaxpr, bytes_of=aval_bytes) -> int:
+    """Peak bytes simultaneously live across the top-level equation list.
+
+    ``bytes_of`` maps an aval to its byte cost and defaults to the global
+    size (``aval_bytes``) — the committed-budget metric.  tools/shardgate's
+    per-shard memory model (SP003) passes a substituted accounting that
+    divides mesh-sharded axes and rescales the node axis, reusing this
+    exact liveness scan so both gates agree on what "live" means."""
     jaxpr = closed_jaxpr.jaxpr
     last_use: Dict[Any, int] = {}
     for i, eqn in enumerate(jaxpr.eqns):
@@ -139,7 +145,7 @@ def peak_live_bytes(closed_jaxpr) -> int:
             last_use[v] = n_eqns          # outputs live to the end
     live = 0
     for v in list(jaxpr.invars) + list(jaxpr.constvars):
-        live += aval_bytes(v.aval)
+        live += bytes_of(v.aval)
     peak = live
     for i, eqn in enumerate(jaxpr.eqns):
         for v in eqn.outvars:
@@ -147,11 +153,11 @@ def peak_live_bytes(closed_jaxpr) -> int:
                 last_use[v] = i           # dead value: dies immediately
     for i, eqn in enumerate(jaxpr.eqns):
         for v in eqn.outvars:
-            live += aval_bytes(v.aval)
+            live += bytes_of(v.aval)
         peak = max(peak, live)
         for v, last in list(last_use.items()):
             if last == i:
-                live -= aval_bytes(v.aval)
+                live -= bytes_of(v.aval)
                 del last_use[v]
     return int(peak)
 

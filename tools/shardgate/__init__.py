@@ -1,0 +1,70 @@
+"""shardgate: static sharding & per-device memory gate.
+
+Fourth pillar of the static-analysis suite (jaxlint → source, concgate →
+concurrency, irgate → jaxpr contracts, shardgate → the partitioned layer).
+Every sharded canonical ladder entry (sharded_group, interleave_sharded,
+bounds bracket/auction, plus the unsharded entries as 1x1 controls) is
+lowered — NOT executed — under a mesh matrix on the virtual 8-device CPU
+backend, and five rule families run against the traced jaxpr, the
+StableHLO, and the post-GSPMD optimized HLO:
+
+- SP001 partition coverage: every consts/carry leaf of a sharded entry
+  carries an explicit PartitionSpec classification; replicated leaves whose
+  64k-extrapolated size clears a byte threshold must be allowlisted by name.
+- SP002 communication audit: per-family collective counts (all-gather,
+  all-to-all, collective-permute, all-reduce, reduce-scatter, SPMD
+  resharding custom_calls) versus a committed per-(entry, mesh) budget.
+  Supersedes IC007's two-marker grep via tools/shardgate/collectives.
+- SP003 per-shard memory model: irgate's liveness scan re-run with
+  per-shard byte accounting, extrapolated across the 2k/16k/64k/100k node
+  ladder x mesh shapes against a pinned device-HBM budget.  The 64k rung
+  must statically fit; the 100k verdict is recorded either way.
+- SP004 padding/divisibility: pad_for_mesh shard multiples and inert-row
+  encodings verified from the lowered shapes and the concrete pad rows.
+- SP005 host-readback audit: device_get/np.asarray/.item() reachable from
+  the sharded drain/scan entry points, via concgate's call graph.
+
+Artifacts: findings name entry + mesh + rule + spec/op + delta;
+``--update-budgets`` regenerates pins (refusing silent loosening);
+SHARDGATE.json feeds tools/trend.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+RULES = {
+    "SP000": "gate integrity: a cell failed to lower or the fixture is "
+             "ambiguous (node/batch pad sizes collide)",
+    "SP001": "partition coverage: unclassified or oversized replicated "
+             "consts/carry leaf on a sharded entry",
+    "SP002": "communication audit: collective count above the committed "
+             "per-(entry, mesh) budget",
+    "SP003": "per-shard memory: extrapolated per-device peak bytes exceed "
+             "the pinned device-HBM budget at the 64k rung",
+    "SP004": "padding invariants: shard-multiple or inert-row encoding "
+             "violated for a (scale, mesh) cell",
+    "SP005": "host readback: device_get/np.asarray/item() reachable inside "
+             "a sharded drain/scan path",
+}
+
+MESH_MATRIX = ("1x1", "2x4", "4x2", "8x1")
+SCALE_LADDER = (2048, 16384, 65536, 100000)
+
+
+@dataclass
+class Finding:
+    entry: str                 # canonical entry name, e.g. sharded_group
+    mesh: str                  # "BxN" mesh cell, or "-" for mesh-independent
+    rule: str                  # SP00x
+    message: str
+    scale: Optional[int] = None
+
+    def render(self) -> str:
+        where = self.entry if self.scale is None \
+            else "%s@%dk" % (self.entry, self.scale // 1000) \
+            if self.scale % 1000 == 0 \
+            else "%s@%d" % (self.entry, self.scale)
+        return "shardgate: %s [%s] %s: %s" % (
+            where, self.mesh, self.rule, self.message)

@@ -1,0 +1,79 @@
+"""Shardgate's committed contract: ``budgets.json`` load/save with the
+one-way ratchet.
+
+The file pins everything the gate compares against:
+
+- ``device_hbm_bytes``            — the per-device HBM the SP003 model
+                                    must fit at the 64k rung,
+- ``replicated_bytes_threshold``  — SP001's size bar for replicated leaves,
+- ``replicated_ok``               — named replicated leaves with reasons,
+- ``readback_ok``                 — named host-sync points with reasons,
+- ``collectives``                 — per-"entry|mesh" collective ceilings.
+
+``--update-budgets`` rewrites ONLY the collective pins (the allowlists and
+the HBM pin are hand-edited, reviewed policy).  The ratchet: a regenerated
+pin may tighten freely, but raising any ceiling — or a run attempting to
+grow ``device_hbm_bytes`` — is refused without ``--allow-looser``, so a
+regression cannot silently re-baseline itself.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+DEFAULT_PATH = os.path.join(os.path.dirname(__file__), "budgets.json")
+
+_HEADER = (
+    "Sharding/memory contract pinned by tools/shardgate (PR 15).  "
+    "`python -m tools.shardgate --update-budgets` regenerates the "
+    "collective pins (tightening only; add --allow-looser to raise a "
+    "ceiling and say why in the commit).  device_hbm_bytes, the "
+    "thresholds, and the *_ok allowlists are hand-edited policy — every "
+    "allowlist value must be a reason a reviewer can check.")
+
+
+def load(path: str = DEFAULT_PATH) -> Optional[dict]:
+    if not os.path.exists(path):
+        return None
+    with open(path, "r", encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def loosenings(old_pins: Dict[str, Dict[str, int]],
+               new_pins: Dict[str, Dict[str, int]]) -> List[str]:
+    """Every (cell, kind) where the regenerated pin is LOOSER than the
+    committed one — a raised ceiling, or a new collective family on an
+    already-pinned cell.  A cell with no pin at all is NEW (a fresh entry
+    or mesh lane) and seeds freely; the ratchet protects existing pins."""
+    out: List[str] = []
+    for name, pin in sorted(new_pins.items()):
+        if name not in old_pins:
+            continue
+        old = old_pins[name]
+        for kind, count in sorted(pin.items()):
+            if count > int(old.get(kind, 0)):
+                out.append(f"{name} {kind}: {int(old.get(kind, 0))} -> "
+                           f"{count}")
+    return out
+
+
+def update(doc: dict, new_pins: Dict[str, Dict[str, int]],
+           allow_looser: bool = False,
+           path: str = DEFAULT_PATH) -> Tuple[bool, List[str]]:
+    """Re-pin the collective ceilings; returns (written, loosenings).
+
+    Refuses (written=False) when the regeneration would loosen any pin and
+    ``allow_looser`` is not set."""
+    worse = loosenings(doc.get("collectives", {}), new_pins)
+    if worse and not allow_looser:
+        return False, worse
+    doc = dict(doc)
+    doc["_comment"] = _HEADER
+    doc["collectives"] = {k: dict(sorted(new_pins[k].items()))
+                          for k in sorted(new_pins)}
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=False)
+        fh.write("\n")
+    return True, worse

@@ -1,0 +1,128 @@
+"""Shared collective-op classification for the static-analysis suite.
+
+One marker table names every cross-device collective family the partitioned
+solves can contain — explicit jaxpr primitives (``all_gather``, ``psum``
+inside a shard_map'd kernel), StableHLO ops, and the collectives GSPMD
+inserts during partitioning (visible only in post-compile optimized HLO).
+Both consumers classify against the SAME table:
+
+- tools/irgate IC007 (`forbid_gather`): "does this program contain a
+  gather-class collective at all?" — substring/word match, the original
+  two-marker semantics, now sourced from here.
+- tools/shardgate SP002: "how many of EACH collective family does this
+  (entry, mesh) cell lower to, versus its committed budget?" — per-op
+  application counts across the StableHLO and compiled-HLO layers.
+
+The SPMD resharding custom_calls (``@Sharding``, ``@SPMDFullToShardShape``,
+``@SPMDShardToFullShape``) are counted as their own family: they are the
+StableHLO-level fingerprints of resharding boundaries, consumed by the
+partitioner before optimized HLO exists.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Optional, Tuple
+
+# family → substring markers (underscore AND hyphen spellings: jaxpr
+# primitive names / StableHLO ops use '_', post-compile HLO uses '-').
+KIND_MARKERS: Dict[str, Tuple[str, ...]] = {
+    "all_gather": ("all_gather", "all-gather"),
+    "all_to_all": ("all_to_all", "all-to-all"),
+    "collective_permute": ("collective_permute", "collective-permute",
+                           "ppermute"),
+    "all_reduce": ("all_reduce", "all-reduce", "psum"),
+    "reduce_scatter": ("reduce_scatter", "reduce-scatter", "psum_scatter"),
+}
+
+# the resharding custom_call targets (StableHLO layer only)
+SHARDING_CUSTOM_CALLS: Tuple[str, ...] = (
+    "@Sharding", "@SPMDFullToShardShape", "@SPMDShardToFullShape",
+)
+CUSTOM_CALL_KIND = "sharding_custom_call"
+
+ALL_KINDS: Tuple[str, ...] = tuple(KIND_MARKERS) + (CUSTOM_CALL_KIND,)
+
+# IC007's gather class: the op families whose presence under
+# Policy(forbid_gather=True) is a contract violation.  Pinned to the
+# original `_GATHER_MARKERS` pair — widening this set widens IC007.
+GATHER_KINDS: Tuple[str, ...] = ("all_gather", "all_to_all")
+
+
+def classify_primitive(name: str) -> Optional[str]:
+    """Collective family of a jaxpr primitive name, or None.
+
+    Substring match, same as IC007's historical `m in name` check, so
+    variants like ``all_gather_invariant`` classify with their base op.
+    ``psum`` maps to all_reduce (that is what it lowers to); ``psum_scatter``
+    is checked first so it lands in reduce_scatter, not all_reduce.
+    """
+    for kind in ("reduce_scatter",):      # longest-marker families first
+        if any(m in name for m in KIND_MARKERS[kind]):
+            return kind
+    for kind, markers in KIND_MARKERS.items():
+        if kind == "reduce_scatter":
+            continue
+        if any(m in name for m in markers):
+            return kind
+    return None
+
+
+def _word_re(kind: str) -> "re.Pattern":
+    alts = "|".join(re.escape(m) for m in KIND_MARKERS[kind])
+    return re.compile(r"\b(?:%s)\b" % alts)
+
+
+_WORD_RES = {kind: _word_re(kind) for kind in KIND_MARKERS}
+
+# Op-application patterns: one match per lowered op, not per mention.
+#  - post-compile HLO:  `%all-reduce.5 = f32[8] all-reduce(...)` — the
+#    application is `all-reduce(`; async pairs add `-start(`.
+#  - StableHLO:         `stablehlo.all_reduce`, `"stablehlo.all_reduce"(...)`
+_APPLY_RES = {
+    kind: re.compile(
+        r"(?:%s)(?:-start)?\(|stablehlo\.(?:%s)\b" % (
+            "|".join(re.escape(m) for m in markers),
+            "|".join(re.escape(m) for m in markers
+                     if "-" not in m)))
+    for kind, markers in KIND_MARKERS.items()
+}
+_CUSTOM_CALL_RE = re.compile(
+    "|".join(re.escape(t) for t in SHARDING_CUSTOM_CALLS))
+
+
+def hlo_counts(text: str) -> Dict[str, int]:
+    """Per-family op-application counts in HLO/StableHLO text.
+
+    Only families with a non-zero count appear, so callers can compare
+    dicts against budgets without a forest of zeros."""
+    out: Dict[str, int] = {}
+    for kind, pat in _APPLY_RES.items():
+        c = len(pat.findall(text))
+        if c:
+            out[kind] = c
+    c = len(_CUSTOM_CALL_RE.findall(text))
+    if c:
+        out[CUSTOM_CALL_KIND] = c
+    return out
+
+
+def hlo_contains(text: str, kinds: Tuple[str, ...]) -> bool:
+    """Word-boundary presence check — IC007's original regex semantics
+    (`\\ball[-_]gather\\b|\\ball[-_]to[-_]all\\b` generalized to any family
+    of the shared table)."""
+    return any(_WORD_RES[k].search(text) for k in kinds if k in _WORD_RES)
+
+
+def jaxpr_counts(closed_jaxpr) -> Dict[str, int]:
+    """Per-family counts of EXPLICIT collective primitives in a jaxpr
+    (recursive; shard_map'd kernels put them here, GSPMD-inserted ones do
+    not exist until compile)."""
+    from ..irgate.costs import iter_eqns
+
+    out: Dict[str, int] = {}
+    for eqn in iter_eqns(closed_jaxpr.jaxpr):
+        kind = classify_primitive(eqn.primitive.name)
+        if kind is not None:
+            out[kind] = out.get(kind, 0) + 1
+    return out

@@ -1,0 +1,84 @@
+"""SP002: the communication audit.
+
+Every (entry, mesh) cell gets its collective profile measured at three IR
+layers — explicit jaxpr primitives, StableHLO resharding custom_calls, and
+the collectives GSPMD actually inserted into the optimized HLO — and the
+compiled-layer profile is compared against the per-cell budget pinned in
+budgets.json.  A collective family exceeding its pinned count is a finding
+naming the op and the delta; a family with no pin at all budgets to zero,
+so a brand-new collective kind trips the gate the round it appears.
+
+The pins are ceilings, maintained by ``--update-budgets``: re-pinning DOWN
+(the partitioner got smarter) is always allowed, re-pinning UP requires
+``--allow-looser`` — the same one-way ratchet perfgate applies to
+throughput floors, inverted for ceilings.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from . import Finding
+from .collectives import CUSTOM_CALL_KIND, hlo_counts, jaxpr_counts
+
+
+def observe(cell) -> Dict[str, Dict[str, int]]:
+    """The three-layer collective profile of one cell.
+
+    - jaxpr:    explicit collective primitives (shard_map'd kernels)
+    - stablehlo: pre-partitioning ops + resharding custom_calls
+    - compiled: what GSPMD inserted — the budgeted layer
+    """
+    return {
+        "jaxpr": jaxpr_counts(cell.jaxpr),
+        "stablehlo": hlo_counts(cell.stablehlo()),
+        "compiled": hlo_counts(cell.compiled_text()),
+    }
+
+
+def budget_profile(observed: Dict[str, Dict[str, int]]) -> Dict[str, int]:
+    """The counts SP002 budgets: the compiled-layer collectives, plus the
+    StableHLO resharding custom_calls (they never survive into optimized
+    HLO, but each one is a resharding boundary worth pinning)."""
+    prof = dict(observed["compiled"])
+    cc = observed["stablehlo"].get(CUSTOM_CALL_KIND, 0)
+    if cc:
+        prof[CUSTOM_CALL_KIND] = cc
+    return prof
+
+
+def check_comms(cells, budgets: dict,
+                table: Dict[str, Dict[str, Dict[str, int]]],
+                ) -> List[Finding]:
+    """SP002 findings for every cell; fills `table` with the full
+    three-layer profiles for the report."""
+    pins: Dict[str, Dict[str, int]] = budgets.get("collectives", {})
+    findings: List[Finding] = []
+    for cell in cells:
+        observed = observe(cell)
+        table[cell.name] = observed
+        prof = budget_profile(observed)
+        pin = pins.get(cell.name)
+        if pin is None:
+            if prof:
+                findings.append(Finding(
+                    cell.entry, cell.mesh_name, "SP002",
+                    f"no collective budget pinned for this cell but it "
+                    f"lowers to {prof} — run --update-budgets to commit "
+                    f"the profile"))
+            continue
+        for kind in sorted(prof):
+            got, cap = prof[kind], int(pin.get(kind, 0))
+            if got > cap:
+                findings.append(Finding(
+                    cell.entry, cell.mesh_name, "SP002",
+                    f"{kind} count {got} exceeds the pinned budget {cap} "
+                    f"(+{got - cap}) — an extra collective crept into the "
+                    f"lowering"))
+    return findings
+
+
+def repin(table: Dict[str, Dict[str, Dict[str, int]]],
+          ) -> Dict[str, Dict[str, int]]:
+    """Fresh pins from an observed table (for --update-budgets)."""
+    return {name: budget_profile(obs) for name, obs in sorted(table.items())}

@@ -1,0 +1,67 @@
+"""Shardgate cell fixtures: the sharded canonical ladder entries, lowered
+through the production `lower_only` seams.
+
+Each entry reuses irgate's fixture builders (same snapshot/pod/profile
+idiom), but at N_NODES=13 nodes instead of 8: the SP003 per-shard memory
+model rescales avals by matching dimension VALUES against the padded node
+and batch axes, so the fixture is sized to keep those values distinct from
+every other dimension the lowered programs contain (resource axes ~6,
+constraint/domain axes 1–4, template axes 2–8, pow2 scan chunks ≥ 64).
+13 pads to 13/14/16 across the mesh matrix while the batch axis pads to
+3/4/8 — never equal.  lowering.py still guards the invariant per cell
+(SP000) in case a future engine change collides.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+# entry name → seam kind; order is the report order
+ENTRIES: Tuple[str, ...] = ("sharded_group", "interleave_sharded",
+                            "bounds_bracket", "bounds_auction")
+
+N_NODES = 13
+N_TEMPLATES = 3
+
+
+def _problems(n_batch: int = N_TEMPLATES):
+    from ..irgate.entries import _problem
+    return [_problem(N_NODES, milli_cpu=300 + 100 * i)
+            for i in range(n_batch)]
+
+
+def lower_entry(entry: str, mesh) -> Optional[dict]:
+    """Run one entry's production path up to the trace boundary.
+
+    Returns the seam dict ({kind, runner, args, consts, carry, meta}) or
+    None when the entry is ineligible on this fixture (callers treat that
+    as a gate-integrity failure — the canonical fixtures must lower).
+    `mesh=None` is the unsharded 1x1 control lane."""
+    if entry == "sharded_group":
+        from cluster_capacity_tpu.parallel import sweep as sweep_mod
+        return sweep_mod.solve_group(_problems(), mesh=mesh,
+                                     lower_only=True)
+    if entry == "interleave_sharded":
+        from cluster_capacity_tpu.models.podspec import default_pod
+        from cluster_capacity_tpu.models.snapshot import ClusterSnapshot
+        from cluster_capacity_tpu.parallel import interleave as il
+        from cluster_capacity_tpu.utils.config import SchedulerProfile
+
+        from ..irgate.entries import _nodes, _pod
+
+        snapshot = ClusterSnapshot.from_objects(_nodes(N_NODES), [])
+        templates = [default_pod(_pod(f"tmpl-{i}", 200 + 100 * i, int(5e7),
+                                      labels={"app": f"tmpl-{i}"}))
+                     for i in range(N_TEMPLATES)]
+        # bounds=False: the bracket/auction kernels are their own cells, and
+        # lower_only must not execute them as a budget side effect
+        return il.solve_interleaved_tensor(
+            snapshot, templates, SchedulerProfile(),
+            mesh=mesh, bounds=False, lower_only=True)
+    if entry == "bounds_bracket":
+        from cluster_capacity_tpu.bounds.bracket import bracket_device
+        return bracket_device(_problems(), mesh=mesh, lower_only=True)
+    if entry == "bounds_auction":
+        from cluster_capacity_tpu.bounds.bracket import auction_device
+        return auction_device(_problems(2), mesh=mesh, lower_only=True)
+    raise KeyError(f"unknown shardgate entry {entry!r}")

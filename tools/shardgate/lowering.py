@@ -1,0 +1,161 @@
+"""Cell assembly: lower every (entry, mesh) pair without executing.
+
+A Cell owns one production computation at one mesh point, traced through
+the engine's `lower_only` seams (sweep.solve_group, interleave's tensor
+race, the bounds bracket/auction runners).  Three IR layers are exposed,
+each computed lazily and at most once:
+
+- ``jaxpr``      — the traced ClosedJaxpr (explicit collectives, avals)
+- ``stablehlo``  — pre-partitioning StableHLO text (resharding
+                   custom_calls, explicit collectives)
+- ``compiled``   — post-GSPMD optimized HLO text (the collectives the
+                   partitioner actually inserted) + input shardings
+
+Nothing here dispatches a solve: `.trace()` is abstract, `.lower()` emits
+IR, `.compile()` runs XLA's compiler only.  The mesh matrix runs on the
+virtual 8-device CPU backend (__main__ forces the device count before jax
+imports).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from . import Finding, MESH_MATRIX
+from .entries import ENTRIES, lower_entry
+
+CTL = "ctl"                      # the unsharded control lane's mesh label
+
+
+def _parse(mesh_name: str):
+    from cluster_capacity_tpu.parallel import mesh as mesh_lib
+    if mesh_name == CTL:
+        return None
+    return mesh_lib.parse_mesh(mesh_name)
+
+
+@dataclass
+class Cell:
+    entry: str
+    mesh_name: str               # "BxN" or "ctl"
+    mesh: object                 # jax Mesh or None
+    seam: dict                   # the lower_only payload
+    _traced: object = field(default=None, repr=False)
+    _lowered: object = field(default=None, repr=False)
+    _compiled: object = field(default=None, repr=False)
+
+    @property
+    def name(self) -> str:
+        return f"{self.entry}|{self.mesh_name}"
+
+    @property
+    def kind(self) -> str:
+        return self.seam["kind"]
+
+    @property
+    def meta(self) -> dict:
+        return self.seam["meta"]
+
+    @property
+    def consts(self) -> Dict[str, object]:
+        return self.seam["consts"]
+
+    @property
+    def carry(self):
+        return self.seam["carry"]
+
+    @property
+    def shards(self) -> Tuple[int, int]:
+        """(batch_shards, node_shards); the control lane is 1x1."""
+        if self.mesh is None:
+            return (1, 1)
+        from cluster_capacity_tpu.parallel import mesh as mesh_lib
+        return (int(self.mesh.shape[mesh_lib.BATCH_AXIS]),
+                int(self.mesh.shape[mesh_lib.NODE_AXIS]))
+
+    def traced(self):
+        if self._traced is None:
+            self._traced = self.seam["runner"].trace(*self.seam["args"])
+        return self._traced
+
+    @property
+    def jaxpr(self):
+        return self.traced().jaxpr
+
+    def lowered(self):
+        if self._lowered is None:
+            self._lowered = self.traced().lower()
+        return self._lowered
+
+    def stablehlo(self) -> str:
+        return self.lowered().as_text(dialect="stablehlo")
+
+    def compiled(self):
+        if self._compiled is None:
+            self._compiled = self.lowered().compile()
+        return self._compiled
+
+    def compiled_text(self) -> str:
+        return self.compiled().as_text()
+
+    # static-arg positions per seam kind (cfg / chunk length are jit
+    # static_argnames and do not appear in the compiled input shardings)
+    _STATIC_SLOTS = {"sweep": (0, 3), "interleave": (0, 4),
+                     "bracket": (), "auction": ()}
+
+    def nonstatic_args(self) -> tuple:
+        """The runner's array arguments, in call order, statics dropped —
+        mirrors what jit flattens into `compiled().input_shardings`."""
+        drop = self._STATIC_SLOTS[self.kind]
+        return tuple(a for i, a in enumerate(self.seam["args"])
+                     if i not in drop)
+
+    def input_sharding_leaves(self):
+        """[(path_str, array leaf, sharding)] joined by tree path between
+        the non-static args and the compiled executable's input shardings.
+        The executable prunes arguments its DCE dropped, so the join is the
+        kept set — exactly the leaves that occupy device memory."""
+        import jax.tree_util as jtu
+
+        args = self.nonstatic_args()
+        leafmap = {jtu.keystr(p): leaf
+                   for p, leaf in jtu.tree_flatten_with_path(args)[0]}
+        shard_tree = self.compiled().input_shardings[0]
+        out = []
+        for p, sh in jtu.tree_flatten_with_path(shard_tree)[0]:
+            key = jtu.keystr(p)
+            if key not in leafmap:
+                raise ValueError(
+                    f"{self.name}: compiled input sharding at {key} has no "
+                    f"matching argument leaf")
+            out.append((key, leafmap[key], sh))
+        return out
+
+
+def build_cells(mesh_names: Tuple[str, ...] = MESH_MATRIX,
+                entries: Tuple[str, ...] = ENTRIES,
+                include_ctl: bool = True,
+                ) -> Tuple[List[Cell], List[Finding]]:
+    """Assemble the full matrix; lowering failures become SP000 findings
+    instead of aborting the gate (one broken cell must not hide the rest)."""
+    lanes = ((CTL,) if include_ctl else ()) + tuple(mesh_names)
+    cells: List[Cell] = []
+    findings: List[Finding] = []
+    for entry in entries:
+        for mesh_name in lanes:
+            try:
+                mesh = _parse(mesh_name)
+                seam = lower_entry(entry, mesh)
+                if seam is None:
+                    findings.append(Finding(
+                        entry, mesh_name, "SP000",
+                        "entry was ineligible at the canonical fixture — "
+                        "nothing lowered"))
+                    continue
+                cells.append(Cell(entry, mesh_name, mesh, seam))
+            except Exception as e:                      # noqa: BLE001
+                findings.append(Finding(
+                    entry, mesh_name, "SP000",
+                    f"failed to lower: {type(e).__name__}: {e}"))
+    return cells, findings

@@ -1,0 +1,183 @@
+"""SP003: the per-shard memory model.
+
+irgate's ``peak_live_bytes`` liveness scan, re-aimed: instead of global
+bytes at the fixture shape, each aval is re-priced under (a) the mesh
+factorization — dimensions equal to the padded node/batch axes divide by
+their shard counts — and (b) a symbolic scale substitution — the node axis
+re-sized to a scale-ladder rung before dividing.  The scan itself is
+``tools.irgate.costs.peak_live_bytes`` with a substituted ``bytes_of``
+(same liveness, same peak definition), extended here to recurse into
+scan/pjit bodies: the top-level scan hides its per-step intermediates (the
+[B, N] score planes that actually dominate), so the recursive peak is
+outer-live-at-the-equation plus the body's own peak.  Sub-jaxpr inputs are
+counted in both frames — a deliberate overestimate, so "proven to fit" is
+conservative.
+
+The model's stated assumption is the sharded regime the other rules
+enforce: every aval carrying the node axis is node-sharded (SP001/SP002
+police gathers that would break that), every aval carrying the batch axis
+is batch-sharded, everything else is replicated.  Dimension matching is by
+VALUE, which is why entries.py sizes the fixture so the padded node/batch
+extents collide with nothing else (guarded per cell by `collision_check`).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from . import Finding, SCALE_LADDER
+
+
+def _itemsize(aval) -> int:
+    dtype = getattr(aval, "dtype", None)
+    return int(getattr(dtype, "itemsize", 1)) if dtype is not None else 1
+
+
+def shape_bytes_at_scale(shape, itemsize: int, n_pad: int, b_pad: int,
+                         shards, scale: int, per_shard: bool = True) -> int:
+    """Byte cost of one array shape after scale substitution.
+
+    per_shard=True divides mesh-sharded axes (the SP003 accounting);
+    per_shard=False keeps the full scaled extents (what a REPLICATED copy
+    of the leaf would occupy on every device — the SP001 threshold)."""
+    nb, nn = shards
+    n_scaled_full = -(-scale // nn) * nn          # padded node extent
+    n_scaled_shard = -(-scale // nn)              # per-shard node rows
+    b_shard = -(-b_pad // nb)
+    total = itemsize
+    for d in shape:
+        d = int(d)
+        if d == n_pad:
+            total *= n_scaled_shard if per_shard else n_scaled_full
+        elif d == b_pad and b_pad > 1:
+            total *= b_shard if per_shard else b_pad
+        else:
+            total *= d
+    return total
+
+
+def bytes_of_factory(meta: dict, shards, scale: int) -> Callable:
+    """A `bytes_of` for irgate's liveness scan: per-shard, scale-substituted
+    aval pricing for one (cell, scale) point."""
+    n_pad, b_pad = int(meta["n_pad"]), int(meta["b_pad"])
+
+    def bytes_of(aval) -> int:
+        return shape_bytes_at_scale(getattr(aval, "shape", ()),
+                                    _itemsize(aval), n_pad, b_pad,
+                                    shards, scale, per_shard=True)
+    return bytes_of
+
+
+def collision_check(cell) -> Optional[Finding]:
+    """SP000 when the fixture's substitution anchors are ambiguous: the
+    padded node extent colliding with the padded batch extent (or either
+    collapsing to 1) would make dimension-value matching rescale the wrong
+    axes silently."""
+    meta = cell.meta
+    n_pad, b_pad = int(meta["n_pad"]), int(meta["b_pad"])
+    chunk = int(meta.get("chunk", 0))
+    if n_pad <= 1 or n_pad == b_pad or n_pad == chunk:
+        return Finding(cell.entry, cell.mesh_name, "SP000",
+                       f"ambiguous memory-model anchors: n_pad={n_pad}, "
+                       f"b_pad={b_pad}, chunk={chunk} — resize the fixture "
+                       f"so the node axis is unique")
+    return None
+
+
+def _peak(jaxpr, bytes_of) -> int:
+    """Recursive liveness peak: irgate's top-level algorithm per frame,
+    plus `outer live + body peak` at every sub-jaxpr equation."""
+    from ..irgate.costs import _subjaxprs
+
+    last_use: Dict[object, int] = {}
+    for i, eqn in enumerate(jaxpr.eqns):
+        for v in eqn.invars:
+            if hasattr(v, "count"):
+                last_use[v] = i
+    n_eqns = len(jaxpr.eqns)
+    for v in jaxpr.outvars:
+        if hasattr(v, "count"):
+            last_use[v] = n_eqns
+    live = 0
+    for v in list(jaxpr.invars) + list(jaxpr.constvars):
+        live += bytes_of(v.aval)
+    peak = live
+    for i, eqn in enumerate(jaxpr.eqns):
+        for v in eqn.outvars:
+            if v not in last_use:
+                last_use[v] = i
+    for i, eqn in enumerate(jaxpr.eqns):
+        inner = 0
+        for _, sub in _subjaxprs(eqn.params):
+            inner = max(inner, _peak(sub, bytes_of))
+        if inner:
+            peak = max(peak, live + inner)
+        for v in eqn.outvars:
+            live += bytes_of(v.aval)
+        peak = max(peak, live)
+        for v, last in list(last_use.items()):
+            if last == i:
+                live -= bytes_of(v.aval)
+                del last_use[v]
+    return int(peak)
+
+
+def peak_per_device_bytes(cell, scale: int) -> int:
+    """Predicted per-device peak live bytes for one cell at one ladder
+    rung, under the mesh factorization."""
+    bytes_of = bytes_of_factory(cell.meta, cell.shards, scale)
+    return _peak(cell.jaxpr.jaxpr, bytes_of)
+
+
+def extrapolate(cell, scales=SCALE_LADDER) -> Dict[int, int]:
+    return {int(s): peak_per_device_bytes(cell, int(s)) for s in scales}
+
+
+def check_memory(cells, budgets: dict,
+                 table: Dict[str, Dict[int, int]]) -> List[Finding]:
+    """SP003 findings + the 64k/100k verdicts.
+
+    `table` is {cell_name: {scale: bytes}} (filled here).  The 64k rung is
+    a hard gate per cell; the 100k rung is recorded in the report (the
+    caller serializes `table`) — pass or named shortfall, never a finding.
+    """
+    hbm = int(budgets["device_hbm_bytes"])
+    findings: List[Finding] = []
+    for cell in cells:
+        bad = collision_check(cell)
+        if bad is not None:
+            findings.append(bad)
+            continue
+        table[cell.name] = extrapolate(cell)
+        b64 = table[cell.name][65536]
+        if b64 > hbm:
+            findings.append(Finding(
+                cell.entry, cell.mesh_name, "SP003",
+                f"64k rung does not fit: predicted per-device peak "
+                f"{b64:,} bytes exceeds the pinned HBM budget {hbm:,} "
+                f"(+{100.0 * (b64 - hbm) / hbm:.1f}%)", scale=65536))
+    return findings
+
+
+def verdicts(table: Dict[str, Dict[int, int]], budgets: dict,
+             cells) -> Dict[str, dict]:
+    """Per-entry 64k/100k verdicts over the mesh lanes: the best (minimum
+    per-device) lane decides, and a 100k shortfall is named, not failed."""
+    hbm = int(budgets["device_hbm_bytes"])
+    out: Dict[str, dict] = {}
+    by_entry: Dict[str, List] = {}
+    for cell in cells:
+        if cell.name in table:
+            by_entry.setdefault(cell.entry, []).append(cell)
+    for entry, group in by_entry.items():
+        doc = {}
+        for scale in (65536, 100000):
+            best = min(group, key=lambda c: table[c.name][scale])
+            b = table[best.name][scale]
+            doc[str(scale)] = {
+                "best_mesh": best.mesh_name, "per_device_bytes": b,
+                "fits": b <= hbm,
+                "shortfall_bytes": max(0, b - hbm),
+            }
+        out[entry] = doc
+    return out

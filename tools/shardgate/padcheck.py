@@ -1,0 +1,142 @@
+"""SP004: padding & divisibility invariants, verified from the lowered
+shapes and the concrete pad rows of every (entry, mesh) cell.
+
+Three layers:
+
+1. **Shard multiples**: the padded node/batch extents recorded by the
+   seam must be exactly the ceil-to-multiple the mesh requires, and the
+   traced program's input avals must carry the PADDED node extent — an
+   aval still holding the unpadded extent means an unpadded table reached
+   the sharded runner (NamedSharding would either crash late or, worse,
+   silently re-layout).
+
+2. **Inert-row encodings**: the appended node rows must hold the fills
+   that make them behaviorally invisible — domain maps -1, missing/ignored
+   masks True, everything else zero; bracket gates False / skew _BIG;
+   auction gates False — checked from the actual argument arrays (input
+   readback only: nothing dispatches).
+
+3. **Scale arithmetic**: at every ladder rung the same ceil-to-multiple
+   must divide evenly and waste less than one shard row per shard —
+   checked symbolically for each mesh lane.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from . import Finding, SCALE_LADDER
+
+
+def _np(x) -> np.ndarray:
+    return np.asarray(x)
+
+
+def _check_pad_region(cell, key: str, arr: np.ndarray, axis: int,
+                      n: int, n_pad: int, want, findings: List[Finding]):
+    if n_pad == n or axis >= arr.ndim:
+        return                   # no pad on this lane / per-problem layout
+    if n_pad == arr.shape[axis]:
+        region = np.take(arr, range(n, n_pad), axis=axis)
+        if region.size and not np.all(region == want):
+            findings.append(Finding(
+                cell.entry, cell.mesh_name, "SP004",
+                f"inert-row encoding violated: pad rows of '{key}' along "
+                f"axis {axis} should be {want!r}"))
+
+
+def check_padding(cell) -> List[Finding]:
+    findings: List[Finding] = []
+    nb, nn = cell.shards
+    meta = cell.meta
+    n, n_pad = int(meta["n_nodes"]), int(meta["n_pad"])
+    b, b_pad = int(meta["batch"]), int(meta["b_pad"])
+
+    # 1) shard multiples
+    want_n = -(-n // nn) * nn
+    if n_pad != want_n:
+        findings.append(Finding(
+            cell.entry, cell.mesh_name, "SP004",
+            f"node axis padded to {n_pad}, expected ceil({n}/{nn})*{nn}"
+            f"={want_n}"))
+    if cell.kind == "interleave":
+        from cluster_capacity_tpu.parallel.interleave import \
+            _quantize_templates
+        # the unsharded path deliberately skips quantization (no mesh, no
+        # shard-multiple constraint) — the ctl lane expects the raw count
+        want_b = _quantize_templates(b, cell.mesh) if cell.mesh is not None \
+            else b
+        if b_pad != want_b:
+            findings.append(Finding(
+                cell.entry, cell.mesh_name, "SP004",
+                f"template axis quantized to {b_pad}, expected {want_b}"))
+    elif cell.kind != "auction" and b_pad % nb:
+        findings.append(Finding(
+            cell.entry, cell.mesh_name, "SP004",
+            f"batch axis {b_pad} is not a multiple of {nb} batch shards"))
+
+    # unpadded node extents must not reach the runner (dim-value check:
+    # entries.py sizes the fixture so n is distinct from every other dim)
+    if n_pad != n:
+        for aval in cell.jaxpr.in_avals:
+            if n in tuple(int(d) for d in getattr(aval, "shape", ())):
+                findings.append(Finding(
+                    cell.entry, cell.mesh_name, "SP004",
+                    f"input aval {getattr(aval, 'shape', ())} still carries "
+                    f"the UNPADDED node extent {n}"))
+                break
+
+    # 2) inert-row fills, from the concrete argument arrays
+    # (_check_pad_region no-ops when an axis carries no pad, so the ctl
+    # lane exercises only the checks that apply to it — e.g. the bracket's
+    # mesh-independent batch quantization rows)
+    if cell.kind in ("sweep", "interleave"):
+        from cluster_capacity_tpu.parallel import interleave as il
+        from cluster_capacity_tpu.parallel import mesh as mesh_lib
+        for key, leaf in sorted(cell.consts.items()):
+            arr = _np(leaf)
+            ax = mesh_lib._NODE_AXIS_OF.get(key)
+            if ax is not None:
+                want = -1 if key in mesh_lib._PAD_NEG else \
+                    (1 if key in mesh_lib._PAD_ONE else 0)
+                _check_pad_region(cell, key, arr, ax + 1, n, n_pad,
+                                  want, findings)
+            elif key in il._XCONSTS_NODE and arr.ndim >= 2:
+                _check_pad_region(cell, key, arr, 1, n, n_pad, 0,
+                                  findings)
+    elif cell.kind == "bracket":
+        from cluster_capacity_tpu.bounds.bracket import _BIG
+        c = {k: _np(v) for k, v in cell.consts.items()}
+        _check_pad_region(cell, "gate", c["gate"], 1, n, n_pad, False,
+                          findings)
+        _check_pad_region(cell, "dom", c["dom"], 2, n, n_pad, -1,
+                          findings)
+        _check_pad_region(cell, "free", c["free"], 1, n, n_pad, 0,
+                          findings)
+        _check_pad_region(cell, "pods_free", c["pods_free"], 1, n,
+                          n_pad, 0, findings)
+        # pad scenarios (batch quantization): gate-False, skew-_BIG rows
+        _check_pad_region(cell, "gate[batch]", c["gate"], 0, b,
+                          b_pad, False, findings)
+        _check_pad_region(cell, "skew[batch]", c["skew"], 0, b,
+                          b_pad, _BIG, findings)
+    elif cell.kind == "auction":
+        c = {k: _np(v) for k, v in cell.consts.items()}
+        _check_pad_region(cell, "gates", c["gates"], 1, n, n_pad,
+                          False, findings)
+        _check_pad_region(cell, "free", c["free"], 0, n, n_pad, 0,
+                          findings)
+        _check_pad_region(cell, "pods_free", c["pods_free"], 0, n,
+                          n_pad, 0, findings)
+
+    # 3) ladder arithmetic per lane
+    for scale in SCALE_LADDER:
+        padded = -(-scale // nn) * nn
+        if padded % nn or padded - scale >= nn:
+            findings.append(Finding(
+                cell.entry, cell.mesh_name, "SP004",
+                f"shard-multiple arithmetic broken: {scale} pads to "
+                f"{padded} under {nn} node shards", scale=scale))
+    return findings

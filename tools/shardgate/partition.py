@@ -1,0 +1,91 @@
+"""SP001: partition coverage.
+
+Two halves, both aimed at the same silent failure — a leaf nobody decided
+to shard riding into a fleet-scale solve fully replicated:
+
+1. **Classification coverage** (source layer): every consts key of a
+   sharded cell must belong to a declared sharding family.  The engine's
+   single sources are `parallel.mesh.classify_const` (build_consts keys)
+   and interleave's `_XCONSTS_NODE` + the cross-template table here; a key
+   in none of them means `consts_shardings` replicated it by fallback.
+
+2. **Replicated-size audit** (compiled layer): walk the compiled
+   executable's actual input shardings (the DCE-kept leaves), and for every
+   leaf whose PartitionSpec is fully unpartitioned, price a replicated copy
+   at the 64k rung (memory.shape_bytes_at_scale, per_shard=False).  Above
+   the byte threshold it must be allowlisted by name in budgets.json with a
+   reason, or it is a finding carrying its spec path.
+
+The bracket/auction cells skip half 1 (their runners take ten explicitly
+spec'd positional planes — nothing can fall through a dict fallback) but
+run half 2 like everyone else.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from . import Finding
+from .memory import _itemsize, shape_bytes_at_scale
+
+# interleave cross-template consts that are DELIBERATELY replicated: tiny
+# [T, T] interaction matrices and per-template vectors the pop reads whole.
+XCONSTS_REPLICATED_OK = frozenset({
+    "sh_xinc", "ss_xinc", "port_conflict",
+    "aff_xinc", "anti_xinc", "eanti_xinc", "pref_xinc",
+    "tier_rank", "preempt_maybe",
+})
+
+SP001_SCALE = 65536            # price replicated leaves at the 64k rung
+
+
+def _classify(key: str) -> bool:
+    from cluster_capacity_tpu.parallel import interleave as il
+    from cluster_capacity_tpu.parallel import mesh as mesh_lib
+    if mesh_lib.classify_const(key) is not None:
+        return True
+    return key in il._XCONSTS_NODE or key in XCONSTS_REPLICATED_OK
+
+
+def check_partition(cell, budgets: dict) -> List[Finding]:
+    findings: List[Finding] = []
+    if cell.mesh is None:
+        return findings          # the ctl lane has no partition contract
+
+    # 1) classification coverage over the dict-shaped consts
+    if cell.kind in ("sweep", "interleave"):
+        for key in sorted(cell.consts):
+            if not _classify(key):
+                findings.append(Finding(
+                    cell.entry, cell.mesh_name, "SP001",
+                    f"consts leaf '{key}' has no declared PartitionSpec "
+                    f"classification — consts_shardings replicates it "
+                    f"silently (classify it in parallel/mesh.py or add it "
+                    f"to REPLICATED_OK with a reason)"))
+
+    # 2) replicated leaves above the threshold, from the compiled truth
+    threshold = int(budgets.get("replicated_bytes_threshold", 1 << 20))
+    allow = budgets.get("replicated_ok", {})
+    meta = cell.meta
+    for path, leaf, sharding in cell.input_sharding_leaves():
+        spec = getattr(sharding, "spec", None)
+        if spec is None:
+            continue             # non-NamedSharding: spec'd by the compiler
+        if any(part is not None for part in spec):
+            continue             # some axis is partitioned
+        shape = tuple(int(d) for d in getattr(leaf, "shape", ()))
+        bytes_64k = shape_bytes_at_scale(
+            shape, _itemsize(leaf), int(meta["n_pad"]), int(meta["b_pad"]),
+            cell.shards, SP001_SCALE, per_shard=False)
+        if bytes_64k < threshold:
+            continue
+        allow_key = f"{cell.entry}{path}"
+        if allow_key in allow:
+            continue
+        findings.append(Finding(
+            cell.entry, cell.mesh_name, "SP001",
+            f"replicated leaf {path} (shape {shape}) would occupy "
+            f"{bytes_64k:,} bytes PER DEVICE at the 64k rung "
+            f"(threshold {threshold:,}); shard it or allowlist "
+            f"'{allow_key}' in budgets.json with a reason", scale=SP001_SCALE))
+    return findings

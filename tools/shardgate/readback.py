@@ -1,0 +1,142 @@
+"""SP005: host-readback audit over the sharded drain/scan paths.
+
+A device_get / np.asarray / ``.item()`` on a sharded intermediate forces
+an all-gather to host and a dispatch sync — inside the chunk loop it turns
+the mesh back into one slow device.  This walk reuses concgate's resolved
+call graph (tools/concgate/context.py): BFS from the sharded solve entry
+points (the sweep group solve, the interleave race, the bounds kernels,
+and the daemon's drain loop that calls them), flagging every reachable
+readback call with its chain from the root.
+
+The walk is name-resolution-bound like concgate's LK005 — and scoped to
+the engine-side packages (parallel/bounds/engine/serve): readbacks in the
+reporting layers happen after results already left the device.  Two
+pruning rules keep the signal honest:
+
+- the walk does not descend into the designed HOST refuges — functions
+  whose name ends ``_host`` (the repo's host-fold convention) and the
+  ``engine.encode`` / ``engine.fast_path`` modules (pre-device encoding,
+  and the fast path irgate's IC006 already holds to zero dispatches) —
+  np.asarray there operates on host data by contract;
+- legitimate sync points on the device path — the per-chunk `chosen`
+  pull is the designed one — are allowlisted by
+  `<module>.<qualname>:<callee>` in budgets.json, each with a reason.
+
+Line numbers are deliberately NOT part of the allowlist key so it
+survives refactors while any NEW readback in an un-allowlisted function
+still trips.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from . import Finding
+
+# exact dotted targets (resolved through import aliases: np → numpy)
+READBACK_CALLS = {
+    "jax.device_get",
+    "numpy.asarray",
+    "numpy.array",
+}
+# attribute calls on arbitrary receivers
+READBACK_ATTRS = ("item",)
+
+# (module suffix, qualname) roots: the sharded drain/scan entry points
+READBACK_ROOTS: Tuple[Tuple[str, str], ...] = (
+    ("parallel.sweep", "solve_group"),
+    ("parallel.sweep", "_batched_solve"),
+    ("parallel.interleave", "solve_interleaved_tensor"),
+    ("bounds.bracket", "bracket_device"),
+    ("bounds.bracket", "auction_device"),
+    ("serve.supervisor", "Supervisor.drain"),
+)
+
+# only descend into these engine-side module families
+_DESCEND_PREFIXES = ("parallel.", "bounds.", "engine.", "serve.")
+
+# ...but never into the designed host-side refuges (see module docstring)
+_HOST_MODULES = ("engine.encode.", "engine.fast_path.")
+
+
+def _is_host_refuge(suffix: str) -> bool:
+    return (suffix.startswith(_HOST_MODULES)
+            or suffix.rsplit(".", 1)[-1].endswith("_host"))
+
+
+def _suffix(ref: str, pkg: str) -> str:
+    return ref.split(f"{pkg}.", 1)[-1]
+
+
+def check_readbacks(repo_root: str, budgets: dict) -> List[Finding]:
+    from ..concgate import build_program
+    from ..concgate.config import PKG, TARGET_DIRS
+    import os
+
+    sources = []
+    for tdir in TARGET_DIRS:
+        base = os.path.join(repo_root, tdir)
+        for dirpath, _dirs, files in os.walk(base):
+            for fn in sorted(files):
+                if fn.endswith(".py"):
+                    path = os.path.join(dirpath, fn)
+                    rel = os.path.relpath(path, repo_root)
+                    with open(path, "r", encoding="utf-8") as fh:
+                        sources.append((rel, fh.read()))
+    prog = build_program(sources)
+
+    allow = budgets.get("readback_ok", {})
+    findings: List[Finding] = []
+    parents: Dict[str, Optional[str]] = {}
+    queue: deque = deque()
+    for mod_suffix, qualname in READBACK_ROOTS:
+        for key in (f"{PKG}.{mod_suffix}", mod_suffix):
+            fs = prog.funcs.get(f"{key}.{qualname}")
+            if fs is not None and fs.ref not in parents:
+                parents[fs.ref] = None
+                queue.append(fs)
+                break
+
+    def chain(ref: str) -> str:
+        hops: List[str] = []
+        cur: Optional[str] = ref
+        while cur is not None:
+            hops.append(_suffix(cur, PKG))
+            cur = parents[cur]
+        return " -> ".join(reversed(hops))
+
+    seen_sites = set()
+    while queue:
+        fs = queue.popleft()
+        fn_suffix = _suffix(fs.ref, PKG)
+        for target, attr, line, _held in fs.calls:
+            name: Optional[str] = None
+            if target in READBACK_CALLS:
+                name = target
+            elif attr in READBACK_ATTRS and target is None:
+                name = f"<expr>.{attr}"
+            if name is not None:
+                site = (fs.module.path, line, name)
+                if site in seen_sites:
+                    continue
+                seen_sites.add(site)
+                allow_key = f"{fn_suffix}:{name.split('.')[-1]}"
+                if allow_key in allow:
+                    continue
+                findings.append(Finding(
+                    "drain_scan_paths", "-", "SP005",
+                    f"host readback {name} at {fs.module.path}:{line} "
+                    f"reachable via {chain(fs.ref)} — hoist it out of the "
+                    f"sharded path or allowlist '{allow_key}' in "
+                    f"budgets.json with a reason"))
+                continue
+            callee = prog.lookup_func(target)
+            if callee is not None and callee.ref not in parents:
+                suffix = _suffix(callee.ref, PKG)
+                if (not suffix.startswith(_DESCEND_PREFIXES)
+                        or _is_host_refuge(suffix)):
+                    continue
+                parents[callee.ref] = fs.ref
+                queue.append(callee)
+    return findings

@@ -28,6 +28,10 @@ ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
 # throughput metrics: a drop beyond this between consecutive reporting
 # rounds is flagged as a regression (matching bench.py's own -10% warning)
 REGRESSION_PCT = 10.0
+# cumulative drift: the latest round sitting this far below the metric's
+# best-ever round is a STANDING regression, even when every individual
+# round-over-round step stayed under REGRESSION_PCT (slow bleed)
+DRIFT_PCT = 20.0
 _RATE_SUFFIXES = ("_per_sec",)
 
 # bench keys that are provenance, not metrics
@@ -145,6 +149,32 @@ def collect(root: str = ROOT) -> dict:
         if doc is not None:
             gates[name] = {"clean": bool(doc.get("clean")),
                            "findings": len(doc.get("findings") or [])}
+    # shardgate's artifact adds the frontier verdicts: per entry, does the
+    # best mesh lane fit the 64k/100k rungs in the pinned device HBM
+    doc = _load(os.path.join(root, "SHARDGATE.json"))
+    if doc is not None:
+        entry = {"clean": bool(doc.get("clean")),
+                 "findings": len(doc.get("findings") or [])}
+        verdicts = doc.get("verdicts")
+        if isinstance(verdicts, dict):
+            entry["fits_64k"] = {
+                e: bool((v.get("65536") or {}).get("fits"))
+                for e, v in sorted(verdicts.items())}
+            entry["fits_100k"] = {
+                e: bool((v.get("100000") or {}).get("fits"))
+                for e, v in sorted(verdicts.items())}
+        gates["shardgate"] = entry
+    # `make gates` merges every gate into GATES.json; sub-gates whose own
+    # artifact was not committed ride in from the merged doc
+    doc = _load(os.path.join(root, "GATES.json"))
+    if isinstance(doc, dict) and isinstance(doc.get("gates"), dict):
+        for name, g in doc["gates"].items():
+            if name not in gates and isinstance(g, dict):
+                entry = {"clean": bool(g.get("clean")),
+                         "findings": int(g.get("findings") or 0)}
+                if g.get("suppressed"):
+                    entry["suppressed"] = int(g["suppressed"])
+                gates[name] = entry
     # concgate's artifact carries an int finding count plus the per-rule
     # split (LK001..LK006) and the suppression tally — the concurrency
     # debt trend, not just a verdict
@@ -231,6 +261,32 @@ def regressions(data: dict) -> List[dict]:
     return out
 
 
+def standing_regressions(data: dict) -> List[dict]:
+    """Throughput metrics whose LATEST round sits more than DRIFT_PCT
+    below their best-ever round — the slow bleed the round-over-round
+    check cannot see (each step under REGRESSION_PCT, the sum far over
+    it).  The best round itself is named so the reviewer can bisect."""
+    out = []
+    for name, series in sorted(data["metrics"].items()):
+        if not name.endswith(_RATE_SUFFIXES):
+            continue
+        rnds = sorted(series)
+        if len(rnds) < 2:
+            continue
+        cur_rnd = rnds[-1]
+        best_rnd = max(rnds, key=lambda r: (series[r], -r))
+        best, cur = series[best_rnd], series[cur_rnd]
+        if best_rnd != cur_rnd and best > 0 \
+                and cur < best * (1 - DRIFT_PCT / 100.0):
+            out.append({
+                "metric": name,
+                "best_round": best_rnd, "best": best,
+                "round": cur_rnd, "value": cur,
+                "drift_pct": round(100.0 * (1 - cur / best), 1),
+            })
+    return out
+
+
 def _fmt(v: Optional[float]) -> str:
     if v is None:
         return "-"
@@ -239,7 +295,8 @@ def _fmt(v: Optional[float]) -> str:
     return f"{v:.2f}"
 
 
-def render_markdown(data: dict, regs: List[dict]) -> str:
+def render_markdown(data: dict, regs: List[dict],
+                    standing: Optional[List[dict]] = None) -> str:
     rounds = data["rounds"]
     lines = ["# Metric trend across CI rounds", ""]
     if not rounds:
@@ -279,4 +336,15 @@ def render_markdown(data: dict, regs: List[dict]) -> str:
     else:
         lines.append("none flagged (throughput metrics within "
                      f"{REGRESSION_PCT:g}% of the previous round)")
+    lines += ["", "## Standing regressions (cumulative drift)", ""]
+    if standing:
+        for s in standing:
+            lines.append(
+                f"- **{s['metric']}**: {_fmt(s['value'])} in "
+                f"r{s['round']:02d} is -{s['drift_pct']}% below its best "
+                f"{_fmt(s['best'])} (r{s['best_round']:02d}) — slow bleed "
+                f"past the {DRIFT_PCT:g}% drift line")
+    else:
+        lines.append("none (every throughput metric within "
+                     f"{DRIFT_PCT:g}% of its best-ever round)")
     return "\n".join(lines) + "\n"

@@ -14,7 +14,8 @@ import json
 import sys
 from typing import List, Optional
 
-from . import ROOT, collect, regressions, render_markdown
+from . import (ROOT, collect, regressions, render_markdown,
+               standing_regressions)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -29,7 +30,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     import os
     data = collect(args.root)
     regs = regressions(data)
-    md = render_markdown(data, regs)
+    standing = standing_regressions(data)
+    md = render_markdown(data, regs, standing)
 
     md_out = args.md_out or os.path.join(args.root, "TREND.md")
     json_out = args.json_out or os.path.join(args.root, "TREND.json")
@@ -39,7 +41,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         json.dump({"trend": 1, "rounds": data["rounds"],
                    "metrics": data["metrics"], "gates": data["gates"],
                    "phases": data.get("phases") or {},
-                   "regressions": regs}, fh, indent=2, sort_keys=True)
+                   "regressions": regs,
+                   "standing_regressions": standing},
+                  fh, indent=2, sort_keys=True)
         fh.write("\n")
 
     if not args.quiet:
@@ -48,8 +52,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                      f"{len(data['rounds'])} round(s) -> "
                      f"{os.path.basename(md_out)}, "
                      f"{os.path.basename(json_out)}; "
-                     f"{len(regs)} regression(s) flagged\n")
-    return 1 if (args.check and regs) else 0
+                     f"{len(regs)} regression(s), "
+                     f"{len(standing)} standing\n")
+    return 1 if (args.check and (regs or standing)) else 0
 
 
 if __name__ == "__main__":
